@@ -1,0 +1,29 @@
+"""sitewhere_tpu — a TPU-native, multitenant device-event platform.
+
+A ground-up rebuild of the capability surface of SiteWhere (the open-source
+IoT application-enablement platform; see SURVEY.md for the layer map and
+component inventory reconstructed from the reference) designed TPU-first:
+
+- The *data plane* is an in-process async event bus with Kafka-compatible
+  semantics (named topics, partitions, consumer groups, committed offsets),
+  carrying **columnar event batches** rather than per-event objects so the
+  hot ingest path is vectorized end to end.  [SURVEY.md §5.8]
+- The *compute plane* is JAX/XLA: anomaly-detection and forecasting models
+  score the event stream at the rule-processing hook point, and training
+  runs over the historical event store under `pjit` on a TPU mesh with ICI
+  collectives.  [SURVEY.md §1 L5/L6, BASELINE.json north_star]
+
+Package layout (SURVEY.md §7):
+  kernel/    lifecycle state machine, event bus, service runtime, metrics
+  domain/    device/asset/event object model + persistence SPIs
+  services/  the domain microservices (device-mgmt, event-mgmt, ingest, ...)
+  models/    JAX model zoo (LSTM anomaly, TFT forecaster, GNN maintenance)
+  ops/       Pallas/fused kernels for hot ops
+  parallel/  mesh construction, shardings, per-tenant sharding
+  scoring/   the TPU model server (admission batching, bucketed shapes)
+  training/  pjit trainers + Orbax checkpointing
+  rest/      REST facade (SiteWhere-compatible surface subset)
+  sim/       device simulator (config 1) / load generator
+"""
+
+__version__ = "0.1.0"

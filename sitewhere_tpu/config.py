@@ -1,0 +1,91 @@
+"""Layered configuration: instance settings → tenant overlays.
+
+Capability parity with SiteWhere's config system [SURVEY.md §5.6]
+(`IInstanceSettings` env bindings → instance config → per-tenant config in
+Zk znodes/CRDs, hot-reload via watch): here the layers are frozen
+dataclasses loaded from env/YAML with an explicit per-tenant overlay dict,
+and "hot reload" is an explicit tenant-engine restart through the lifecycle
+state machine (no ZooKeeper).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+try:  # yaml is present in this image; gate anyway for minimal installs
+    import yaml
+except ImportError:  # pragma: no cover
+    yaml = None
+
+
+@dataclass(frozen=True)
+class InstanceSettings:
+    """Instance-global settings (reference: `IInstanceSettings`)."""
+
+    instance_id: str = "swx1"
+    # bus
+    bus_default_partitions: int = 4
+    bus_retention: int = 4096
+    # REST facade
+    rest_host: str = "127.0.0.1"
+    rest_port: int = 8080
+    jwt_secret: str = "swx-dev-secret"
+    jwt_expiration_s: int = 3600
+    # scoring plane
+    scoring_batch_window_ms: float = 2.0
+    scoring_batch_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
+    # log level
+    log_level: str = "INFO"
+
+    @staticmethod
+    def from_env(**overrides: Any) -> "InstanceSettings":
+        env_map = {
+            "instance_id": os.environ.get("SWX_INSTANCE_ID"),
+            "rest_port": os.environ.get("SWX_REST_PORT"),
+            "jwt_secret": os.environ.get("SWX_JWT_SECRET"),
+        }
+        kwargs: dict[str, Any] = {k: v for k, v in env_map.items() if v is not None}
+        if "rest_port" in kwargs:
+            kwargs["rest_port"] = int(kwargs["rest_port"])
+        kwargs.update(overrides)
+        return InstanceSettings(**kwargs)
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """Per-tenant configuration overlay (reference: tenant config znodes).
+
+    Services read their section via `section()`; unknown keys are preserved
+    so service-specific config rides along without kernel changes.
+    """
+
+    tenant_id: str
+    name: str = ""
+    authorized_user_ids: tuple[str, ...] = ()
+    sections: dict[str, Any] = field(default_factory=dict, hash=False, compare=False)
+
+    def section(self, name: str, default: Optional[dict] = None) -> dict:
+        return dict(self.sections.get(name, default or {}))
+
+    def with_section(self, name: str, values: dict) -> "TenantConfig":
+        sections = dict(self.sections)
+        sections[name] = {**sections.get(name, {}), **values}
+        return dataclasses.replace(self, sections=sections)
+
+
+def load_yaml_config(path: str) -> tuple[InstanceSettings, list[TenantConfig]]:
+    """Load `instance:` settings and a `tenants:` list from one YAML file."""
+    if yaml is None:  # pragma: no cover
+        raise RuntimeError("pyyaml not available")
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    inst = InstanceSettings.from_env(**(doc.get("instance") or {}))
+    tenants = []
+    for t in doc.get("tenants") or []:
+        t = dict(t)
+        sections = t.pop("sections", {})
+        tenants.append(TenantConfig(sections=sections, **t))
+    return inst, tenants

@@ -1,0 +1,59 @@
+"""Domain object model + persistence SPIs (reference layer L2).
+
+Capability parity with SiteWhere's `sitewhere-core-api` object model and
+SPI interfaces [SURVEY.md §1 L2, §2.1 "Object model + SPIs"]: devices,
+device types/commands/statuses, assignments, groups, customers, areas,
+zones, assets, tenants, users, and the device-event family — plus the SPI
+protocols every datastore implements.
+
+TPU-first addition: `batch.py` defines the **columnar** representations
+(struct-of-arrays over numpy) that actually transit the bus on the hot
+path; per-event dataclasses exist for the API surface and persistence
+queries, and converters go both ways.
+"""
+
+from sitewhere_tpu.domain.model import (
+    Area,
+    Asset,
+    AssetType,
+    Customer,
+    Device,
+    DeviceAssignment,
+    DeviceAssignmentStatus,
+    DeviceCommand,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceStatus,
+    DeviceType,
+    Tenant,
+    User,
+    Zone,
+)
+from sitewhere_tpu.domain.events import (
+    AlertLevel,
+    DeviceAlert,
+    DeviceCommandInvocation,
+    DeviceCommandResponse,
+    DeviceEvent,
+    DeviceEventType,
+    DeviceLocation,
+    DeviceMeasurement,
+    DeviceStateChange,
+)
+from sitewhere_tpu.domain.batch import (
+    AlertBatch,
+    LocationBatch,
+    MeasurementBatch,
+    RegistrationBatch,
+)
+
+__all__ = [
+    "Area", "Asset", "AssetType", "Customer", "Device", "DeviceAssignment",
+    "DeviceAssignmentStatus", "DeviceCommand", "DeviceGroup",
+    "DeviceGroupElement", "DeviceStatus", "DeviceType", "Tenant", "User",
+    "Zone",
+    "AlertLevel", "DeviceAlert", "DeviceCommandInvocation",
+    "DeviceCommandResponse", "DeviceEvent", "DeviceEventType",
+    "DeviceLocation", "DeviceMeasurement", "DeviceStateChange",
+    "AlertBatch", "LocationBatch", "MeasurementBatch", "RegistrationBatch",
+]

@@ -1,0 +1,197 @@
+"""Columnar event batches + the SWB1 binary wire protocol.
+
+This module is the TPU-first core of the data plane. The reference moves
+one protobuf-encoded event per MQTT message and re-marshals it at every
+hop (agent proto → POJO → Kafka proto → POJO..., [SURVEY.md §2.1
+"Protobuf wire model", §3.2]); at 1M events/sec that per-event cost is the
+wall. Here:
+
+- Devices emit (or gateways aggregate) **batches** of telemetry in SWB1, a
+  fixed-stride little-endian columnar format. Decoding is a handful of
+  `np.frombuffer` views — nanoseconds per event, independent of batch size.
+- Batches stay columnar (struct-of-arrays) through decode → enrich →
+  persist → score; the arrays feed `jax.device_put` directly with no
+  per-event materialization.
+- Per-event objects (`domain.events`) are produced only at the API/query
+  surface.
+
+SWB1 layout (little-endian):
+  header: magic b"SWB1" | msg_type u8 | flags u8 | count u32   (10 bytes)
+  measurements (msg_type=1): device_index u32[N] | mtype u16[N]
+                             | value f32[N] | ts f64[N]
+  locations    (msg_type=2): device_index u32[N] | lat f64[N] | lon f64[N]
+                             | elevation f32[N] | ts f64[N]
+JSON fallback decoders for token-addressed payloads (registration, alerts,
+low-rate devices) live in `services/event_sources.py`.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+MAGIC = b"SWB1"
+MSG_MEASUREMENTS = 1
+MSG_LOCATIONS = 2
+
+_HEADER = struct.Struct("<4sBBI")
+
+
+@dataclass(slots=True)
+class BatchContext:
+    """Trace/latency envelope carried with every batch [SURVEY.md §5.1].
+
+    `ingest_monotonic` is stamped when the receiver first sees the payload;
+    end-to-end p99 latency is measured against it at the scoring sink.
+    """
+
+    tenant_id: str
+    source: str = ""
+    trace_id: int = 0
+    ingest_monotonic: float = field(default_factory=time.monotonic)
+
+
+@dataclass(slots=True)
+class MeasurementBatch:
+    """N scalar measurements, columnar. The hot-path record type."""
+
+    ctx: BatchContext
+    device_index: np.ndarray  # uint32 [N] dense per-tenant device slot
+    mtype: np.ndarray         # uint16 [N] channel id within device type
+    value: np.ndarray         # float32 [N]
+    ts: np.ndarray            # float64 [N] epoch seconds (event_date)
+
+    def __len__(self) -> int:
+        return int(self.device_index.shape[0])
+
+    # -- SWB1 codec --------------------------------------------------------
+
+    def encode(self) -> bytes:
+        n = len(self)
+        return b"".join((
+            _HEADER.pack(MAGIC, MSG_MEASUREMENTS, 0, n),
+            np.ascontiguousarray(self.device_index, np.uint32).tobytes(),
+            np.ascontiguousarray(self.mtype, np.uint16).tobytes(),
+            np.ascontiguousarray(self.value, np.float32).tobytes(),
+            np.ascontiguousarray(self.ts, np.float64).tobytes(),
+        ))
+
+    @staticmethod
+    def decode(payload: bytes | memoryview, ctx: BatchContext) -> "MeasurementBatch":
+        magic, msg_type, _flags, n = _HEADER.unpack_from(payload, 0)
+        if magic != MAGIC or msg_type != MSG_MEASUREMENTS:
+            raise ValueError(f"not an SWB1 measurement batch (type={msg_type})")
+        mv = memoryview(payload)
+        o = _HEADER.size
+        dev = np.frombuffer(mv, np.uint32, n, o); o += 4 * n
+        mtype = np.frombuffer(mv, np.uint16, n, o); o += 2 * n
+        value = np.frombuffer(mv, np.float32, n, o); o += 4 * n
+        ts = np.frombuffer(mv, np.float64, n, o)
+        return MeasurementBatch(ctx, dev, mtype, value, ts)
+
+    @staticmethod
+    def concat(batches: Sequence["MeasurementBatch"]) -> "MeasurementBatch":
+        assert batches, "concat of empty batch list"
+        return MeasurementBatch(
+            batches[0].ctx,
+            np.concatenate([b.device_index for b in batches]),
+            np.concatenate([b.mtype for b in batches]),
+            np.concatenate([b.value for b in batches]),
+            np.concatenate([b.ts for b in batches]),
+        )
+
+    def select(self, mask: np.ndarray) -> "MeasurementBatch":
+        return MeasurementBatch(self.ctx, self.device_index[mask],
+                                self.mtype[mask], self.value[mask], self.ts[mask])
+
+
+@dataclass(slots=True)
+class LocationBatch:
+    """N GPS fixes, columnar."""
+
+    ctx: BatchContext
+    device_index: np.ndarray  # uint32 [N]
+    latitude: np.ndarray      # float64 [N]
+    longitude: np.ndarray     # float64 [N]
+    elevation: np.ndarray     # float32 [N]
+    ts: np.ndarray            # float64 [N]
+
+    def __len__(self) -> int:
+        return int(self.device_index.shape[0])
+
+    def encode(self) -> bytes:
+        n = len(self)
+        return b"".join((
+            _HEADER.pack(MAGIC, MSG_LOCATIONS, 0, n),
+            np.ascontiguousarray(self.device_index, np.uint32).tobytes(),
+            np.ascontiguousarray(self.latitude, np.float64).tobytes(),
+            np.ascontiguousarray(self.longitude, np.float64).tobytes(),
+            np.ascontiguousarray(self.elevation, np.float32).tobytes(),
+            np.ascontiguousarray(self.ts, np.float64).tobytes(),
+        ))
+
+    @staticmethod
+    def decode(payload: bytes | memoryview, ctx: BatchContext) -> "LocationBatch":
+        magic, msg_type, _flags, n = _HEADER.unpack_from(payload, 0)
+        if magic != MAGIC or msg_type != MSG_LOCATIONS:
+            raise ValueError(f"not an SWB1 location batch (type={msg_type})")
+        mv = memoryview(payload)
+        o = _HEADER.size
+        dev = np.frombuffer(mv, np.uint32, n, o); o += 4 * n
+        lat = np.frombuffer(mv, np.float64, n, o); o += 8 * n
+        lon = np.frombuffer(mv, np.float64, n, o); o += 8 * n
+        elev = np.frombuffer(mv, np.float32, n, o); o += 4 * n
+        ts = np.frombuffer(mv, np.float64, n, o)
+        return LocationBatch(ctx, dev, lat, lon, elev, ts)
+
+
+@dataclass(slots=True)
+class AlertBatch:
+    """Device-originated alerts (cold path; strings stay as lists)."""
+
+    ctx: BatchContext
+    device_index: np.ndarray          # uint32 [N]
+    level: np.ndarray                 # uint8 [N] (AlertLevel values)
+    type: list[str] = field(default_factory=list)
+    message: list[str] = field(default_factory=list)
+    ts: Optional[np.ndarray] = None   # float64 [N]
+    source: str = "device"
+
+    def __len__(self) -> int:
+        return int(self.device_index.shape[0])
+
+
+@dataclass(slots=True)
+class RegistrationBatch:
+    """Device self-registration requests (cold path) [SURVEY.md §2.2
+    device-registration]: hardware tokens + requested device type."""
+
+    ctx: BatchContext
+    device_tokens: list[str]
+    device_type_token: str
+    area_token: Optional[str] = None
+    customer_token: Optional[str] = None
+    metadata: dict = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.device_tokens)
+
+
+@dataclass(slots=True)
+class ScoredBatch:
+    """Output of the model plane for one scored MeasurementBatch:
+    per-event anomaly scores + the boolean alert decisions."""
+
+    ctx: BatchContext
+    device_index: np.ndarray  # uint32 [N]
+    score: np.ndarray         # float32 [N]
+    is_anomaly: np.ndarray    # bool [N]
+    ts: np.ndarray            # float64 [N]
+    model_version: int = 0
+
+    def __len__(self) -> int:
+        return int(self.device_index.shape[0])

@@ -1,0 +1,35 @@
+"""Microservice kernel: lifecycle, event bus, service runtime, metrics.
+
+Rebuilds the capability of SiteWhere's `sitewhere-microservice` module
+[SURVEY.md §2.1]: every runtime component is a LifecycleComponent with an
+explicit init/start/stop state machine; services host per-tenant engines;
+cross-service traffic rides the topic bus (Kafka semantics, in-proc impl).
+"""
+
+from sitewhere_tpu.kernel.lifecycle import (
+    LifecycleComponent,
+    LifecycleException,
+    LifecycleProgressMonitor,
+    LifecycleStatus,
+)
+from sitewhere_tpu.kernel.bus import EventBus, BusConsumer, TopicRecord
+from sitewhere_tpu.kernel.service import (
+    Service,
+    TenantEngine,
+    TenantEngineManager,
+    ServiceRuntime,
+)
+
+__all__ = [
+    "LifecycleComponent",
+    "LifecycleException",
+    "LifecycleProgressMonitor",
+    "LifecycleStatus",
+    "EventBus",
+    "BusConsumer",
+    "TopicRecord",
+    "Service",
+    "TenantEngine",
+    "TenantEngineManager",
+    "ServiceRuntime",
+]

@@ -1,0 +1,354 @@
+"""In-process event bus with Kafka-compatible topic semantics.
+
+Rebuilds the capability of SiteWhere's Kafka integration layer
+(`MicroserviceKafkaProducer`, `MicroserviceKafkaConsumer`,
+`KafkaTopicNaming` — [SURVEY.md §2.1 "Kafka integration", §5.8]) as an
+in-process asyncio bus that preserves the semantics the platform relies on:
+
+- named topics split into ordered partitions
+- producers partition by key hash (per-device ordering guarantee)
+- consumer groups with partition assignment and rebalance on join/leave
+- committed offsets per (group, topic, partition) → at-least-once delivery,
+  resume-from-last-committed after a consumer restart [SURVEY.md §5.4]
+- bounded retention with a moving base offset (old records trimmed)
+
+TPU-first twist: record *values* are expected to be columnar event batches
+(see `sitewhere_tpu.domain.batch`), so a "record" is typically thousands of
+device events — the per-record asyncio overhead amortizes to ~nothing and
+the hot path stays vectorized. Per-event objects never transit the bus.
+
+A real-Kafka adapter can implement the same `produce/subscribe` surface
+later without touching any service code (SURVEY.md §7 non-goals at v1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+from sitewhere_tpu.kernel.lifecycle import LifecycleComponent, LifecycleProgressMonitor
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True, slots=True)
+class TopicRecord:
+    """One record as seen by a consumer (analog of ConsumerRecord)."""
+
+    topic: str
+    partition: int
+    offset: int
+    key: Optional[str]
+    value: Any
+    timestamp: float
+
+
+class _PartitionLog:
+    """Append-only log for one partition, with bounded retention."""
+
+    __slots__ = ("records", "base_offset", "cond")
+
+    def __init__(self) -> None:
+        self.records: list[tuple[Optional[str], Any, float]] = []
+        self.base_offset = 0  # offset of records[0]
+        self.cond = asyncio.Condition()
+
+    @property
+    def end_offset(self) -> int:
+        return self.base_offset + len(self.records)
+
+    def trim(self, retain: int) -> None:
+        excess = len(self.records) - retain
+        if excess > 0:
+            del self.records[:excess]
+            self.base_offset += excess
+
+
+class _Topic:
+    __slots__ = ("name", "partitions", "retention")
+
+    def __init__(self, name: str, num_partitions: int, retention: int) -> None:
+        self.name = name
+        self.partitions = [_PartitionLog() for _ in range(num_partitions)]
+        self.retention = retention
+
+
+@dataclass
+class _GroupState:
+    """Consumer-group bookkeeping: members, assignment, committed offsets."""
+
+    members: list["BusConsumer"] = field(default_factory=list)
+    # (topic, partition) -> committed offset (next offset to read)
+    committed: dict[tuple[str, int], int] = field(default_factory=dict)
+    generation: int = 0
+
+    def rebalance(self, bus: "EventBus") -> None:
+        """Range-assign every subscribed topic's partitions over members."""
+        self.generation += 1
+        for member in self.members:
+            member._assignment = []
+        for topic_name in sorted({t for m in self.members for t in m._topics}):
+            topic = bus._topics.get(topic_name)
+            if topic is None:
+                continue
+            subscribers = [m for m in self.members if topic_name in m._topics]
+            for p in range(len(topic.partitions)):
+                owner = subscribers[p % len(subscribers)]
+                owner._assignment.append((topic_name, p))
+        for member in self.members:
+            member._positions = {}  # re-fetch from committed on next poll
+            member._generation = self.generation
+
+
+class EventBus(LifecycleComponent):
+    """The instance-wide topic bus (one per ServiceRuntime)."""
+
+    def __init__(self, name: str = "event-bus", *, default_partitions: int = 4,
+                 retention: int = 4096):
+        super().__init__(name)
+        self._topics: dict[str, _Topic] = {}
+        self._groups: dict[str, _GroupState] = {}
+        self._default_partitions = default_partitions
+        self._retention = retention
+        self._rr = itertools.count()  # round-robin for keyless produce
+
+    # -- admin -------------------------------------------------------------
+
+    def create_topic(self, name: str, *, partitions: Optional[int] = None,
+                     retention: Optional[int] = None) -> None:
+        if name not in self._topics:
+            self._topics[name] = _Topic(
+                name, partitions or self._default_partitions,
+                retention or self._retention)
+
+    def topic_names(self) -> list[str]:
+        return sorted(self._topics)
+
+    def end_offsets(self, topic: str) -> list[int]:
+        t = self._topics[topic]
+        return [p.end_offset for p in t.partitions]
+
+    # -- produce -----------------------------------------------------------
+
+    def _select_partition(self, topic: _Topic, key: Optional[str]) -> int:
+        n = len(topic.partitions)
+        if key is None:
+            return next(self._rr) % n
+        return zlib.crc32(key.encode()) % n
+
+    async def produce(self, topic_name: str, value: Any, *,
+                      key: Optional[str] = None,
+                      partition: Optional[int] = None) -> tuple[int, int]:
+        """Append a record; returns (partition, offset)."""
+        self.create_topic(topic_name)
+        topic = self._topics[topic_name]
+        p = partition if partition is not None else self._select_partition(topic, key)
+        log = topic.partitions[p]
+        async with log.cond:
+            offset = log.end_offset
+            log.records.append((key, value, time.time()))
+            log.trim(topic.retention)
+            log.cond.notify_all()
+        return p, offset
+
+    def produce_nowait(self, topic_name: str, value: Any, *,
+                       key: Optional[str] = None,
+                       partition: Optional[int] = None) -> tuple[int, int]:
+        """Synchronous append for non-async producers (e.g. bench loops).
+
+        Waiting consumers are woken via call_soon on the running loop if any.
+        """
+        self.create_topic(topic_name)
+        topic = self._topics[topic_name]
+        p = partition if partition is not None else self._select_partition(topic, key)
+        log = topic.partitions[p]
+        offset = log.end_offset
+        log.records.append((key, value, time.time()))
+        log.trim(topic.retention)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            loop.call_soon(_notify_cond, log.cond)
+        return p, offset
+
+    # -- consume -----------------------------------------------------------
+
+    def subscribe(self, topics: Iterable[str] | str, *, group: str,
+                  name: Optional[str] = None) -> "BusConsumer":
+        if isinstance(topics, str):
+            topics = [topics]
+        for t in topics:
+            self.create_topic(t)
+        state = self._groups.setdefault(group, _GroupState())
+        consumer = BusConsumer(self, group, list(topics),
+                               name or f"{group}-{len(state.members)}")
+        state.members.append(consumer)
+        state.rebalance(self)
+        return consumer
+
+    def _leave(self, consumer: "BusConsumer") -> None:
+        state = self._groups.get(consumer.group)
+        if state and consumer in state.members:
+            state.members.remove(consumer)
+            if state.members:
+                state.rebalance(self)
+
+    async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
+        # wake all pollers so closing consumers notice shutdown promptly
+        for topic in self._topics.values():
+            for log in topic.partitions:
+                async with log.cond:
+                    log.cond.notify_all()
+
+
+def _notify_cond(cond: asyncio.Condition) -> None:
+    # fire-and-forget notify from sync context
+    async def _n() -> None:
+        async with cond:
+            cond.notify_all()
+    asyncio.ensure_future(_n())
+
+
+class BusConsumer:
+    """A consumer-group member (analog of MicroserviceKafkaConsumer).
+
+    `poll()` returns records past this member's position on its assigned
+    partitions; `commit()` persists positions to the group so a restarted
+    member resumes from last commit (at-least-once).
+    """
+
+    def __init__(self, bus: EventBus, group: str, topics: list[str], name: str):
+        self._bus = bus
+        self.group = group
+        self.name = name
+        self._topics = topics
+        self._assignment: list[tuple[str, int]] = []
+        self._positions: dict[tuple[str, int], int] = {}
+        self._generation = -1
+        self._closed = False
+
+    @property
+    def assignment(self) -> tuple[tuple[str, int], ...]:
+        return tuple(self._assignment)
+
+    def _position(self, tp: tuple[str, int]) -> int:
+        pos = self._positions.get(tp)
+        if pos is None:
+            state = self._bus._groups[self.group]
+            pos = state.committed.get(tp, 0)
+            log = self._bus._topics[tp[0]].partitions[tp[1]]
+            if pos < log.base_offset:  # trimmed past committed offset
+                logger.warning("%s: offset %d behind base %d on %s, resetting",
+                               self.name, pos, log.base_offset, tp)
+                pos = log.base_offset
+            self._positions[tp] = pos
+        return pos
+
+    def poll_nowait(self, max_records: int = 512) -> list[TopicRecord]:
+        """Drain available records without waiting."""
+        out: list[TopicRecord] = []
+        for tp in self._assignment:
+            if len(out) >= max_records:
+                break
+            topic_name, p = tp
+            log = self._bus._topics[topic_name].partitions[p]
+            pos = self._position(tp)
+            if pos < log.base_offset:
+                pos = log.base_offset
+            take = min(log.end_offset - pos, max_records - len(out))
+            if take <= 0:
+                continue
+            start = pos - log.base_offset
+            for i in range(take):
+                key, value, ts = log.records[start + i]
+                out.append(TopicRecord(topic_name, p, pos + i, key, value, ts))
+            self._positions[tp] = pos + take
+        return out
+
+    async def poll(self, *, max_records: int = 512,
+                   timeout: float = 1.0) -> list[TopicRecord]:
+        """Wait up to `timeout` for records on assigned partitions."""
+        records = self.poll_nowait(max_records)
+        if records or self._closed:
+            return records
+        # wait on the first assigned partition's condition; producers notify
+        # per-partition, so with multiple assigned partitions poll degrades to
+        # a short re-check loop (fine: record arrival is the common wake).
+        deadline = time.monotonic() + timeout
+        while not records and not self._closed:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if not self._assignment:
+                await asyncio.sleep(min(remaining, 0.05))
+            else:
+                topic_name, p = self._assignment[0]
+                log = self._bus._topics[topic_name].partitions[p]
+                async with log.cond:
+                    try:
+                        await asyncio.wait_for(
+                            log.cond.wait(),
+                            min(remaining, 0.05 if len(self._assignment) > 1 else remaining))
+                    except asyncio.TimeoutError:
+                        pass
+            records = self.poll_nowait(max_records)
+        return records
+
+    def commit(self) -> None:
+        """Commit current positions to the group (next-offset convention)."""
+        state = self._bus._groups[self.group]
+        for tp, pos in self._positions.items():
+            prev = state.committed.get(tp, 0)
+            if pos > prev:
+                state.committed[tp] = pos
+
+    def seek_to_beginning(self) -> None:
+        for tp in self._assignment:
+            log = self._bus._topics[tp[0]].partitions[tp[1]]
+            self._positions[tp] = log.base_offset
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._bus._leave(self)
+
+
+class TopicNaming:
+    """Topic naming convention (reference: `KafkaTopicNaming`).
+
+    `<instance>.tenant.<tenant>.<function>` for tenant-scoped topics and
+    `<instance>.instance.<function>` for instance-global ones — kept verbatim
+    so dashboards/adapters written against the reference's names still work.
+    """
+
+    # tenant-scoped pipeline functions [SURVEY.md §3.2]
+    EVENT_SOURCE_DECODED = "event-source-decoded-events"
+    EVENT_SOURCE_FAILED = "event-source-failed-decode-events"
+    INBOUND_EVENTS = "inbound-events"
+    INBOUND_REPROCESS = "inbound-reprocess-events"
+    UNREGISTERED_DEVICES = "unregistered-device-events"
+    INBOUND_PERSISTED = "inbound-persisted-events"
+    OUTBOUND_ENRICHED = "outbound-enriched-events"
+    OUTBOUND_COMMANDS = "outbound-command-invocations"
+    UNDELIVERED_COMMANDS = "undelivered-command-invocations"
+    BATCH_ELEMENTS = "batch-operation-elements"
+    SCORED_EVENTS = "scored-events"              # new: model-plane output
+    # instance-scoped
+    TENANT_MODEL_UPDATES = "tenant-model-updates"
+    INSTANCE_LOGS = "instance-logs"
+
+    def __init__(self, instance_id: str):
+        self.instance_id = instance_id
+
+    def tenant_topic(self, tenant_id: str, function: str) -> str:
+        return f"{self.instance_id}.tenant.{tenant_id}.{function}"
+
+    def instance_topic(self, function: str) -> str:
+        return f"{self.instance_id}.instance.{function}"

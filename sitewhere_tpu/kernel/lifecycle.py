@@ -1,0 +1,249 @@
+"""Lifecycle state machine for every runtime component.
+
+Capability parity with SiteWhere's lifecycle framework
+(`LifecycleComponent`, `LifecycleProgressMonitor`, `CompositeLifecycleStep`,
+`LifecycleStatus` — [SURVEY.md §2.1 "Lifecycle framework"]): components are
+initialized, started, and stopped through an explicit state machine with
+progress reporting, child-component composition, and error capture.
+
+Differences from the reference (deliberate, not accidental):
+- async-first: all transitions are coroutines on a single event loop, which
+  removes the reference's need for per-component locks [SURVEY.md §5.2].
+- transitions are validated against an explicit table; invalid transitions
+  raise instead of silently proceeding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+import traceback
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class LifecycleStatus(enum.Enum):
+    """Component lifecycle states (reference: `LifecycleStatus` enum)."""
+
+    STOPPED = "stopped"                # constructed or cleanly stopped
+    INITIALIZING = "initializing"
+    INITIALIZED = "initialized"
+    STARTING = "starting"
+    STARTED = "started"
+    PAUSED = "paused"
+    STOPPING = "stopping"
+    TERMINATED = "terminated"          # stopped and will never restart
+    INITIALIZATION_ERROR = "initialization_error"
+    LIFECYCLE_ERROR = "lifecycle_error"
+
+
+# states from which each transition may legally begin
+_CAN_INITIALIZE = {LifecycleStatus.STOPPED, LifecycleStatus.INITIALIZATION_ERROR,
+                   LifecycleStatus.LIFECYCLE_ERROR}
+_CAN_START = {LifecycleStatus.INITIALIZED, LifecycleStatus.PAUSED,
+              LifecycleStatus.STOPPED, LifecycleStatus.LIFECYCLE_ERROR}
+_CAN_STOP = {LifecycleStatus.STARTED, LifecycleStatus.PAUSED,
+             LifecycleStatus.LIFECYCLE_ERROR, LifecycleStatus.STARTING}
+
+
+class LifecycleException(Exception):
+    """Raised when a lifecycle transition fails or is illegal."""
+
+
+class LifecycleProgressMonitor:
+    """Collects step-by-step progress of a lifecycle transition.
+
+    Reference analog: `LifecycleProgressMonitor` with nested progress
+    contexts. Here: a flat list of (component_path, step, elapsed_s) records
+    plus an optional callback, which is all the REST surface needs.
+    """
+
+    def __init__(self, on_step: Optional[Callable[[str, str, float], None]] = None):
+        self.steps: list[tuple[str, str, float]] = []
+        self._on_step = on_step
+        self._t0 = time.monotonic()
+
+    def report(self, component: str, step: str) -> None:
+        elapsed = time.monotonic() - self._t0
+        self.steps.append((component, step, elapsed))
+        logger.debug("[lifecycle %7.3fs] %s: %s", elapsed, component, step)
+        if self._on_step:
+            self._on_step(component, step, elapsed)
+
+
+class LifecycleComponent:
+    """Base class for every runtime component.
+
+    Subclasses override the `_do_initialize/_do_start/_do_stop` hooks; the
+    public `initialize/start/stop` methods run the state machine, recurse
+    into children in declaration order (reverse order for stop), and capture
+    errors into the component's `error` field, moving it to an error state
+    (reference: error states on `LifecycleComponent`).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.status = LifecycleStatus.STOPPED
+        self.error: Optional[BaseException] = None
+        self.error_trace: Optional[str] = None
+        self._children: list[LifecycleComponent] = []
+        self.parent: Optional[LifecycleComponent] = None
+
+    # -- composition -------------------------------------------------------
+
+    def add_child(self, child: "LifecycleComponent") -> "LifecycleComponent":
+        child.parent = self
+        self._children.append(child)
+        return child
+
+    @property
+    def children(self) -> tuple["LifecycleComponent", ...]:
+        return tuple(self._children)
+
+    @property
+    def path(self) -> str:
+        if self.parent is None:
+            return self.name
+        return f"{self.parent.path}/{self.name}"
+
+    # -- hooks (override in subclasses) ------------------------------------
+
+    async def _do_initialize(self, monitor: LifecycleProgressMonitor) -> None:
+        pass
+
+    async def _do_start(self, monitor: LifecycleProgressMonitor) -> None:
+        pass
+
+    async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
+        pass
+
+    # -- state machine -----------------------------------------------------
+
+    async def initialize(self, monitor: Optional[LifecycleProgressMonitor] = None) -> None:
+        monitor = monitor or LifecycleProgressMonitor()
+        if self.status not in _CAN_INITIALIZE:
+            raise LifecycleException(
+                f"{self.path}: cannot initialize from {self.status.value}")
+        self.status = LifecycleStatus.INITIALIZING
+        self.error = None
+        self.error_trace = None
+        monitor.report(self.path, "initializing")
+        try:
+            await self._do_initialize(monitor)
+            for child in self._children:
+                await child.initialize(monitor)
+            self.status = LifecycleStatus.INITIALIZED
+            monitor.report(self.path, "initialized")
+        except BaseException as exc:  # noqa: BLE001 - recorded, then re-raised
+            self._record_error(exc, LifecycleStatus.INITIALIZATION_ERROR)
+            raise LifecycleException(f"{self.path}: initialize failed: {exc}") from exc
+
+    async def start(self, monitor: Optional[LifecycleProgressMonitor] = None) -> None:
+        monitor = monitor or LifecycleProgressMonitor()
+        if self.status == LifecycleStatus.STOPPED:
+            await self.initialize(monitor)
+        if self.status not in _CAN_START:
+            raise LifecycleException(
+                f"{self.path}: cannot start from {self.status.value}")
+        self.status = LifecycleStatus.STARTING
+        monitor.report(self.path, "starting")
+        try:
+            await self._do_start(monitor)
+            for child in self._children:
+                await child.start(monitor)
+            self.status = LifecycleStatus.STARTED
+            monitor.report(self.path, "started")
+        except BaseException as exc:  # noqa: BLE001
+            self._record_error(exc, LifecycleStatus.LIFECYCLE_ERROR)
+            raise LifecycleException(f"{self.path}: start failed: {exc}") from exc
+
+    async def stop(self, monitor: Optional[LifecycleProgressMonitor] = None) -> None:
+        monitor = monitor or LifecycleProgressMonitor()
+        if self.status in (LifecycleStatus.STOPPED, LifecycleStatus.TERMINATED,
+                           LifecycleStatus.INITIALIZED):
+            return  # already not running
+        if self.status not in _CAN_STOP:
+            raise LifecycleException(
+                f"{self.path}: cannot stop from {self.status.value}")
+        self.status = LifecycleStatus.STOPPING
+        monitor.report(self.path, "stopping")
+        first_error: Optional[BaseException] = None
+        # children stop before the parent, in reverse declaration order
+        for child in reversed(self._children):
+            try:
+                await child.stop(monitor)
+            except BaseException as exc:  # noqa: BLE001 - keep stopping others
+                first_error = first_error or exc
+        try:
+            await self._do_stop(monitor)
+        except BaseException as exc:  # noqa: BLE001
+            first_error = first_error or exc
+        if first_error is not None:
+            self._record_error(first_error, LifecycleStatus.LIFECYCLE_ERROR)
+            raise LifecycleException(
+                f"{self.path}: stop failed: {first_error}") from first_error
+        self.status = LifecycleStatus.STOPPED
+        monitor.report(self.path, "stopped")
+
+    async def restart(self, monitor: Optional[LifecycleProgressMonitor] = None) -> None:
+        await self.stop(monitor)
+        await self.initialize(monitor)
+        await self.start(monitor)
+
+    async def terminate(self) -> None:
+        if self.status in _CAN_STOP:
+            await self.stop()
+        self.status = LifecycleStatus.TERMINATED
+
+    def _record_error(self, exc: BaseException, status: LifecycleStatus) -> None:
+        self.error = exc
+        self.error_trace = traceback.format_exc()
+        self.status = status
+        logger.error("%s entered %s: %s", self.path, status.value, exc)
+
+    # -- introspection -----------------------------------------------------
+
+    def state_tree(self) -> dict:
+        """Status of this component and all descendants (health endpoint)."""
+        return {
+            "name": self.name,
+            "status": self.status.value,
+            "error": repr(self.error) if self.error else None,
+            "children": [c.state_tree() for c in self._children],
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self.path} {self.status.value}>"
+
+
+class BackgroundTaskComponent(LifecycleComponent):
+    """A lifecycle component that owns an asyncio task while STARTED.
+
+    Many services are 'a poll loop with a lifecycle' (reference: Kafka
+    consumer wrappers, [SURVEY.md §2.1 "Kafka integration"]); this base
+    manages task spawn/cancel so subclasses only write `_run()`.
+    """
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._task: Optional[asyncio.Task] = None
+
+    async def _run(self) -> None:  # pragma: no cover - override
+        raise NotImplementedError
+
+    async def _do_start(self, monitor: LifecycleProgressMonitor) -> None:
+        self._task = asyncio.create_task(self._run(), name=self.path)
+
+    async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            except BaseException:  # noqa: BLE001 - task error surfaces here
+                logger.exception("%s: background task failed during stop", self.path)
+            self._task = None

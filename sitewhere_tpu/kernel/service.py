@@ -1,0 +1,271 @@
+"""Service runtime: services, per-tenant engines, and the instance runtime.
+
+Capability parity with SiteWhere's microservice kernel
+(`Microservice`, `MultitenantMicroservice`, `MicroserviceTenantEngine`,
+`TenantEngineManager` — [SURVEY.md §2.1, §3.1, §3.5]):
+
+- a `Service` is one logical microservice (device-management,
+  inbound-processing, ...) with a lifecycle and an API object other
+  services can call;
+- a multitenant `Service` hosts one `TenantEngine` per tenant, spun
+  up/down in response to tenant-model-update records on the instance bus
+  (the reference broadcast the same way over Kafka, §3.5);
+- a `ServiceRuntime` is the whole instance: the bus, topic naming, metrics,
+  and the set of services. In the reference each service is a separate JVM
+  on k8s; here they share one process/event-loop by default, which is what
+  collapses the reference's four broker hops on the scoring path
+  [SURVEY.md §3.2 hot-loop note] while keeping topics observable.
+
+Cross-service calls: the reference goes through gRPC `ApiChannel`s with
+wait-for-available retry [SURVEY.md §2.1 "gRPC plumbing"]. Here
+`ServiceRuntime.api(identifier)` returns the target service's API object
+directly, and `wait_for_api(identifier)` gives the same
+wait-until-available semantics for startup ordering.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional
+
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.kernel.bus import EventBus, TopicNaming
+from sitewhere_tpu.kernel.lifecycle import (
+    BackgroundTaskComponent,
+    LifecycleComponent,
+    LifecycleProgressMonitor,
+    LifecycleStatus,
+)
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class TenantEngine(LifecycleComponent):
+    """Per-tenant engine inside a service (reference: MicroserviceTenantEngine)."""
+
+    def __init__(self, service: "Service", tenant: TenantConfig):
+        super().__init__(f"tenant-{tenant.tenant_id}")
+        self.service = service
+        self.tenant = tenant
+
+    @property
+    def runtime(self) -> "ServiceRuntime":
+        return self.service.runtime
+
+    @property
+    def tenant_id(self) -> str:
+        return self.tenant.tenant_id
+
+    def tenant_topic(self, function: str) -> str:
+        return self.runtime.naming.tenant_topic(self.tenant_id, function)
+
+
+class Service(LifecycleComponent):
+    """One logical microservice (reference: ConfigurableMicroservice).
+
+    Subclasses set `identifier` and either override the lifecycle hooks
+    directly (global services) or implement `create_tenant_engine()`
+    (multitenant services; a `TenantEngineManager` child is attached
+    automatically when `multitenant=True`).
+    """
+
+    identifier: str = "service"
+    multitenant: bool = False
+
+    def __init__(self, runtime: "ServiceRuntime"):
+        super().__init__(self.identifier)
+        self.runtime = runtime
+        self.engines: dict[str, TenantEngine] = {}
+        if self.multitenant:
+            self.engine_manager = TenantEngineManager(self)
+            self.add_child(self.engine_manager)
+
+    # -- tenant engines ----------------------------------------------------
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> TenantEngine:
+        raise NotImplementedError(f"{self.identifier} is not multitenant")
+
+    def engine(self, tenant_id: str) -> TenantEngine:
+        try:
+            return self.engines[tenant_id]
+        except KeyError:
+            raise KeyError(
+                f"{self.identifier}: no engine for tenant {tenant_id!r} "
+                f"(known: {sorted(self.engines)})") from None
+
+    async def start_tenant_engine(self, tenant: TenantConfig) -> TenantEngine:
+        existing = self.engines.get(tenant.tenant_id)
+        if existing is not None:
+            await existing.stop()
+        engine = self.create_tenant_engine(tenant)
+        self.engines[tenant.tenant_id] = engine
+        await engine.initialize()
+        await engine.start()
+        return engine
+
+    async def stop_tenant_engine(self, tenant_id: str) -> None:
+        engine = self.engines.pop(tenant_id, None)
+        if engine is not None:
+            await engine.stop()
+
+    # -- convenience -------------------------------------------------------
+
+    @property
+    def bus(self) -> EventBus:
+        return self.runtime.bus
+
+    @property
+    def naming(self) -> TopicNaming:
+        return self.runtime.naming
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self.runtime.metrics
+
+    def api(self) -> Any:
+        """The object other services call (override where applicable)."""
+        return self
+
+
+class TenantEngineManager(BackgroundTaskComponent):
+    """Watches tenant-model-updates and spins engines (reference: §3.5).
+
+    Records on the instance topic look like
+    `{"action": "created"|"updated"|"deleted", "tenant": TenantConfig}`.
+    """
+
+    def __init__(self, service: Service):
+        super().__init__("tenant-engine-manager")
+        self.service = service
+
+    async def _run(self) -> None:
+        runtime = self.service.runtime
+        consumer = runtime.bus.subscribe(
+            runtime.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
+            group=f"{self.service.identifier}.tenant-engines",
+            name=f"{self.service.identifier}.tenant-engines")
+        try:
+            # bootstrap tenants already known to the runtime
+            for tenant in runtime.tenants.values():
+                if tenant.tenant_id not in self.service.engines:
+                    await self.service.start_tenant_engine(tenant)
+            while True:
+                for record in await consumer.poll(timeout=0.5):
+                    update = record.value
+                    action, tenant = update["action"], update["tenant"]
+                    try:
+                        if action in ("created", "updated"):
+                            await self.service.start_tenant_engine(tenant)
+                        elif action == "deleted":
+                            await self.service.stop_tenant_engine(tenant.tenant_id)
+                    except Exception:  # noqa: BLE001 - engine error is isolated
+                        logger.exception("%s: tenant %s %s failed",
+                                         self.service.identifier,
+                                         tenant.tenant_id, action)
+                consumer.commit()
+        finally:
+            consumer.close()
+
+    async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
+        await super()._do_stop(monitor)
+        for tenant_id in list(self.service.engines):
+            await self.service.stop_tenant_engine(tenant_id)
+
+
+class ServiceRuntime(LifecycleComponent):
+    """The whole instance: bus + services + tenants (reference: an
+    instance's set of microservices plus its Kafka cluster)."""
+
+    def __init__(self, settings: Optional[InstanceSettings] = None):
+        settings = settings or InstanceSettings()
+        super().__init__(f"instance-{settings.instance_id}")
+        self.settings = settings
+        self.naming = TopicNaming(settings.instance_id)
+        self.metrics = MetricsRegistry()
+        self.bus = EventBus(default_partitions=settings.bus_default_partitions,
+                            retention=settings.bus_retention)
+        self.add_child(self.bus)
+        self.services: dict[str, Service] = {}
+        self.tenants: dict[str, TenantConfig] = {}
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_service(self, service: Service) -> Service:
+        if service.identifier in self.services:
+            raise ValueError(f"duplicate service {service.identifier}")
+        self.services[service.identifier] = service
+        self.add_child(service)
+        return service
+
+    def api(self, identifier: str) -> Any:
+        """In-proc equivalent of a gRPC ApiChannel to `identifier`."""
+        return self.services[identifier].api()
+
+    async def wait_for_api(self, identifier: str, timeout: float = 10.0) -> Any:
+        """Wait-for-available retry (reference: ApiChannel.waitForApiAvailable)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            svc = self.services.get(identifier)
+            if svc is not None and svc.status == LifecycleStatus.STARTED:
+                return svc.api()
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"api {identifier} not available after {timeout}s")
+            await asyncio.sleep(0.01)
+
+    # -- tenants -----------------------------------------------------------
+
+    async def add_tenant(self, tenant: TenantConfig) -> None:
+        """Register a tenant and broadcast creation (reference: §3.5)."""
+        self.tenants[tenant.tenant_id] = tenant
+        await self.bus.produce(
+            self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
+            {"action": "created", "tenant": tenant}, key=tenant.tenant_id)
+        await self._await_engines(tenant.tenant_id)
+
+    async def update_tenant(self, tenant: TenantConfig) -> None:
+        self.tenants[tenant.tenant_id] = tenant
+        await self.bus.produce(
+            self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
+            {"action": "updated", "tenant": tenant}, key=tenant.tenant_id)
+        await self._await_engines(tenant.tenant_id)
+
+    async def remove_tenant(self, tenant_id: str) -> None:
+        tenant = self.tenants.pop(tenant_id, None)
+        if tenant is None:
+            return
+        await self.bus.produce(
+            self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
+            {"action": "deleted", "tenant": tenant}, key=tenant_id)
+        await self._await_engines(tenant_id, present=False)
+
+    async def _await_engines(self, tenant_id: str, *, present: bool = True,
+                             timeout: float = 10.0) -> None:
+        """Block until every multitenant service has (or drops) the engine."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        multitenant = [s for s in self.services.values()
+                       if s.multitenant and s.status == LifecycleStatus.STARTED]
+        while True:
+            current = self.tenants.get(tenant_id)
+
+            def ready(s: Service) -> bool:
+                eng = s.engines.get(tenant_id)
+                if present:
+                    # engine must be running *and* built from the current
+                    # config object (update spins a fresh engine, §3.5)
+                    return (eng is not None
+                            and eng.status == LifecycleStatus.STARTED
+                            and eng.tenant is current)
+                return eng is None
+            if all(ready(s) for s in multitenant):
+                return
+            if asyncio.get_event_loop().time() > deadline:
+                lagging = [s.identifier for s in multitenant if not ready(s)]
+                raise TimeoutError(
+                    f"tenant {tenant_id} engines not {'ready' if present else 'removed'}"
+                    f" in {timeout}s: {lagging}")
+            await asyncio.sleep(0.005)
+
+    def health(self) -> dict:
+        return self.state_tree()

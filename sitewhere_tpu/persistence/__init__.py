@@ -1,0 +1,28 @@
+"""Persistence: in-memory SPI implementations + the columnar telemetry store.
+
+The reference persists events to MongoDB/InfluxDB/Cassandra behind
+`IDeviceEventManagement` [SURVEY.md §2.2 event-management]. Here the
+default store is TPU-shaped: telemetry lives in per-tenant ring buffers
+laid out `[device, time]` so scoring windows and training datasets are
+zero-copy array slices (no row→tensor conversion step at train time).
+External-store adapters can implement the same SPIs later.
+"""
+
+from sitewhere_tpu.persistence.telemetry import TelemetryStore, TelemetryTable
+from sitewhere_tpu.persistence.memory import (
+    InMemoryAssetManagement,
+    InMemoryBatchManagement,
+    InMemoryDeviceEventManagement,
+    InMemoryDeviceManagement,
+    InMemoryScheduleManagement,
+    InMemoryTenantManagement,
+    InMemoryUserManagement,
+)
+
+__all__ = [
+    "TelemetryStore", "TelemetryTable",
+    "InMemoryAssetManagement", "InMemoryBatchManagement",
+    "InMemoryDeviceEventManagement", "InMemoryDeviceManagement",
+    "InMemoryScheduleManagement", "InMemoryTenantManagement",
+    "InMemoryUserManagement",
+]

@@ -1,0 +1,27 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices.
+
+Per SURVEY.md §4 (rebuild test strategy): TPU tests run identically on CPU
+via a host-platform device mesh, so sharding/pjit tests exercise real
+multi-device semantics without TPU hardware. Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def run():
+    """Run a coroutine on a fresh event loop (sync test driver)."""
+
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run

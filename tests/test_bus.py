@@ -1,0 +1,119 @@
+"""Event bus semantics tests: partitioning, groups, offsets, at-least-once
+[SURVEY.md §2.1 Kafka integration, §5.8]."""
+
+import asyncio
+
+from sitewhere_tpu.kernel.bus import EventBus, TopicNaming
+
+
+def test_key_partitioning_is_stable(run):
+    async def main():
+        bus = EventBus(default_partitions=4)
+        parts = set()
+        for _ in range(5):
+            p, _ = await bus.produce("t", "v", key="device-42")
+            parts.add(p)
+        assert len(parts) == 1  # same key → same partition (ordering)
+
+    run(main())
+
+
+def test_consumer_group_splits_partitions(run):
+    async def main():
+        bus = EventBus(default_partitions=4)
+        c1 = bus.subscribe("t", group="g")
+        c2 = bus.subscribe("t", group="g")
+        assert len(c1.assignment) == 2 and len(c2.assignment) == 2
+        assert set(c1.assignment).isdisjoint(c2.assignment)
+        # all 4 partitions covered
+        assert len(set(c1.assignment) | set(c2.assignment)) == 4
+        # leave → rebalance gives survivor everything
+        c2.close()
+        assert len(c1.assignment) == 4
+
+    run(main())
+
+
+def test_commit_resume_at_least_once(run):
+    async def main():
+        bus = EventBus(default_partitions=1)
+        for i in range(10):
+            await bus.produce("t", i)
+        c = bus.subscribe("t", group="g")
+        records = await c.poll(max_records=4)
+        assert [r.value for r in records] == [0, 1, 2, 3]
+        c.commit()
+        # consumed-but-uncommitted records are redelivered after restart
+        more = await c.poll(max_records=3)
+        assert [r.value for r in more] == [4, 5, 6]
+        c.close()  # no commit of 4..6
+        c2 = bus.subscribe("t", group="g")
+        redelivered = await c2.poll(max_records=10)
+        assert [r.value for r in redelivered] == [4, 5, 6, 7, 8, 9]
+
+    run(main())
+
+
+def test_independent_groups_see_all_records(run):
+    async def main():
+        bus = EventBus(default_partitions=2)
+        for i in range(6):
+            await bus.produce("t", i, key=str(i))
+        a = bus.subscribe("t", group="ga")
+        b = bus.subscribe("t", group="gb")
+        va = sorted(r.value for r in await a.poll(max_records=100))
+        vb = sorted(r.value for r in await b.poll(max_records=100))
+        assert va == vb == [0, 1, 2, 3, 4, 5]
+
+    run(main())
+
+
+def test_retention_trims_and_consumer_resets(run):
+    async def main():
+        bus = EventBus(default_partitions=1, retention=5)
+        for i in range(12):
+            await bus.produce("t", i)
+        c = bus.subscribe("t", group="g")
+        records = await c.poll(max_records=100)
+        # only the retained tail is visible; offsets are preserved
+        assert [r.value for r in records] == [7, 8, 9, 10, 11]
+        assert records[0].offset == 7
+
+    run(main())
+
+
+def test_poll_wakes_on_produce(run):
+    async def main():
+        bus = EventBus(default_partitions=1)
+        c = bus.subscribe("t", group="g")
+
+        async def producer():
+            await asyncio.sleep(0.05)
+            await bus.produce("t", "hello")
+
+        task = asyncio.create_task(producer())
+        records = await c.poll(timeout=2.0)
+        await task
+        assert [r.value for r in records] == ["hello"]
+
+    run(main())
+
+
+def test_produce_nowait_from_sync_context(run):
+    async def main():
+        bus = EventBus(default_partitions=1)
+        c = bus.subscribe("t", group="g")
+        bus.produce_nowait("t", 1)
+        bus.produce_nowait("t", 2)
+        records = await c.poll(timeout=1.0)
+        assert [r.value for r in records] == [1, 2]
+
+    run(main())
+
+
+def test_topic_naming_convention():
+    naming = TopicNaming("swx1")
+    assert naming.tenant_topic("acme", TopicNaming.EVENT_SOURCE_DECODED) == \
+        "swx1.tenant.acme.event-source-decoded-events"
+    assert naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES) == \
+        "swx1.instance.tenant-model-updates"

@@ -1,0 +1,162 @@
+"""Domain model, SWB1 codec, and persistence store tests."""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.domain.batch import (
+    BatchContext,
+    LocationBatch,
+    MeasurementBatch,
+)
+from sitewhere_tpu.domain.model import (
+    Device,
+    DeviceAssignment,
+    DeviceAssignmentStatus,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceType,
+)
+from sitewhere_tpu.domain.spi import (
+    DeviceEventManagementSPI,
+    DeviceManagementSPI,
+)
+from sitewhere_tpu.persistence.memory import (
+    InMemoryDeviceEventManagement,
+    InMemoryDeviceManagement,
+    InMemoryUserManagement,
+)
+from sitewhere_tpu.domain.model import User
+from sitewhere_tpu.persistence.telemetry import TelemetryTable
+
+
+def ctx():
+    return BatchContext(tenant_id="t1", source="test")
+
+
+def test_swb1_measurement_roundtrip():
+    b = MeasurementBatch(
+        ctx(),
+        np.arange(100, dtype=np.uint32),
+        np.zeros(100, dtype=np.uint16),
+        np.linspace(0, 1, 100, dtype=np.float32),
+        np.full(100, 1234.5, dtype=np.float64),
+    )
+    payload = b.encode()
+    out = MeasurementBatch.decode(payload, ctx())
+    np.testing.assert_array_equal(out.device_index, b.device_index)
+    np.testing.assert_array_equal(out.value, b.value)
+    np.testing.assert_array_equal(out.ts, b.ts)
+    assert len(out) == 100
+
+
+def test_swb1_location_roundtrip():
+    b = LocationBatch(
+        ctx(),
+        np.asarray([1, 2], np.uint32),
+        np.asarray([33.75, 33.76]),
+        np.asarray([-84.39, -84.40]),
+        np.asarray([300.0, 301.0], np.float32),
+        np.asarray([1.0, 2.0]),
+    )
+    out = LocationBatch.decode(b.encode(), ctx())
+    np.testing.assert_allclose(out.latitude, b.latitude)
+    np.testing.assert_allclose(out.elevation, b.elevation)
+
+
+def test_swb1_rejects_wrong_type():
+    b = MeasurementBatch(ctx(), np.zeros(1, np.uint32), np.zeros(1, np.uint16),
+                         np.zeros(1, np.float32), np.zeros(1, np.float64))
+    with pytest.raises(ValueError):
+        LocationBatch.decode(b.encode(), ctx())
+
+
+def test_telemetry_ring_ordering_and_window():
+    t = TelemetryTable(history=8, initial_devices=4)
+    # two appends to device 0, interleaved devices, in-batch duplicates
+    t.append(np.asarray([0, 1, 0, 1, 0]), np.asarray([1, 10, 2, 20, 3], np.float32),
+             np.asarray([1.0, 1.0, 2.0, 2.0, 3.0]))
+    vals, valid = t.window(np.asarray([0, 1]), 4)
+    # chronological, left-padded
+    np.testing.assert_array_equal(vals[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(valid[0], [False, True, True, True])
+    np.testing.assert_array_equal(vals[1][2:], [10, 20])
+    # wrap-around: append 10 more to device 0 (history=8)
+    t.append(np.zeros(10, np.int64), np.arange(100, 110, dtype=np.float32),
+             np.arange(10, 20, dtype=np.float64))
+    vals, valid = t.window(np.asarray([0]), 8)
+    np.testing.assert_array_equal(vals[0], np.arange(102, 110))
+    assert valid.all()
+
+
+def test_telemetry_capacity_growth():
+    t = TelemetryTable(history=4, initial_devices=2)
+    t.append(np.asarray([1000]), np.asarray([7.0], np.float32), np.asarray([1.0]))
+    assert t.capacity > 1000
+    vals, valid = t.window(np.asarray([1000]), 1)
+    assert vals[0, 0] == 7.0 and valid[0, 0]
+
+
+def test_device_management_dense_indices_and_spi():
+    dm = InMemoryDeviceManagement()
+    assert isinstance(dm, DeviceManagementSPI)
+    dt = dm.create_device_type(DeviceType(token="thermo", name="Thermometer"))
+    d0 = dm.create_device(Device(token="dev-0", device_type_id=dt.id))
+    d1 = dm.create_device(Device(token="dev-1", device_type_id=dt.id))
+    assert (d0.index, d1.index) == (0, 1)
+    assert dm.index_of_token("dev-1") == 1
+    assert dm.tokens_to_indices(["dev-0", "nope", "dev-1"]) == [0, -1, 1]
+    assert dm.get_device_by_index(0).token == "dev-0"
+    with pytest.raises(ValueError):
+        dm.create_device(Device(token="dev-0", device_type_id=dt.id))
+
+    a = dm.create_device_assignment(DeviceAssignment(device_id=d0.id))
+    assert a.device_type_id == dt.id
+    assert dm.get_active_assignments_for_device(d0.id) == [a]
+    released = dm.release_device_assignment(a.id)
+    assert released.status == DeviceAssignmentStatus.RELEASED
+    assert dm.get_active_assignments_for_device(d0.id) == []
+
+
+def test_device_groups_nested_expansion():
+    dm = InMemoryDeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    devices = [dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+               for i in range(4)]
+    inner = dm.create_device_group(DeviceGroup(token="inner", name="inner"))
+    outer = dm.create_device_group(DeviceGroup(token="outer", name="outer"))
+    dm.add_device_group_elements(inner.id, [
+        DeviceGroupElement(device_id=devices[0].id),
+        DeviceGroupElement(device_id=devices[1].id)])
+    dm.add_device_group_elements(outer.id, [
+        DeviceGroupElement(nested_group_id=inner.id),
+        DeviceGroupElement(device_id=devices[3].id)])
+    expanded = {d.token for d in dm.expand_group_devices(outer.id)}
+    assert expanded == {"d0", "d1", "d3"}
+
+
+def test_event_management_hot_and_cold():
+    dm = InMemoryDeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    d = dm.create_device(Device(token="d0", device_type_id=dt.id))
+    dm.create_device_assignment(DeviceAssignment(device_id=d.id))
+    em = InMemoryDeviceEventManagement(dm, history=16)
+    assert isinstance(em, DeviceEventManagementSPI)
+    batch = MeasurementBatch(
+        ctx(), np.zeros(5, np.uint32), np.zeros(5, np.uint16),
+        np.asarray([1, 2, 3, 4, 5], np.float32), np.asarray([1., 2., 3., 4., 5.]))
+    assert em.add_measurements(batch) == 5
+    ms = em.list_measurements(0)
+    assert [m.value for m in ms] == [1, 2, 3, 4, 5]
+    assert ms[0].device_id == d.id and ms[0].assignment_id
+
+    # date-range filter
+    ms = em.list_measurements(0, start=2.5, end=4.5)
+    assert [m.value for m in ms] == [3, 4]
+
+
+def test_user_management_auth_roundtrip():
+    um = InMemoryUserManagement()
+    um.create_user(User(username="admin", authorities=("REST", "ADMIN")), "s3cret")
+    assert um.authenticate("admin", "s3cret").username == "admin"
+    assert um.authenticate("admin", "wrong") is None
+    assert um.authenticate("ghost", "x") is None

@@ -1,0 +1,98 @@
+"""Lifecycle state machine tests [SURVEY.md §2.1 lifecycle framework]."""
+
+import pytest
+
+from sitewhere_tpu.kernel.lifecycle import (
+    LifecycleComponent,
+    LifecycleException,
+    LifecycleProgressMonitor,
+    LifecycleStatus,
+)
+
+
+class Recorder(LifecycleComponent):
+    def __init__(self, name, log, fail_on=None):
+        super().__init__(name)
+        self.log = log
+        self.fail_on = fail_on or set()
+
+    async def _do_initialize(self, monitor):
+        if "initialize" in self.fail_on:
+            raise RuntimeError(f"{self.name} init boom")
+        self.log.append((self.name, "init"))
+
+    async def _do_start(self, monitor):
+        if "start" in self.fail_on:
+            raise RuntimeError(f"{self.name} start boom")
+        self.log.append((self.name, "start"))
+
+    async def _do_stop(self, monitor):
+        self.log.append((self.name, "stop"))
+
+
+def test_full_cycle_orders_children(run):
+    log = []
+    root = Recorder("root", log)
+    a = root.add_child(Recorder("a", log))
+    a.add_child(Recorder("a1", log))
+    root.add_child(Recorder("b", log))
+
+    async def main():
+        await root.start()
+        assert root.status == LifecycleStatus.STARTED
+        assert all(c.status == LifecycleStatus.STARTED for c in root.children)
+        await root.stop()
+
+    run(main())
+    # init/start parent-first, depth-first; stop reverse order children-first
+    assert log.index(("root", "init")) < log.index(("a", "init")) < log.index(("a1", "init"))
+    assert log.index(("a1", "start")) < log.index(("b", "start"))
+    assert log.index(("b", "stop")) < log.index(("a1", "stop")) < log.index(("root", "stop"))
+    assert root.status == LifecycleStatus.STOPPED
+
+
+def test_initialize_error_recorded(run):
+    log = []
+    root = Recorder("root", log)
+    root.add_child(Recorder("bad", log, fail_on={"initialize"}))
+
+    with pytest.raises(LifecycleException):
+        run(root.initialize())
+    assert root.status == LifecycleStatus.INITIALIZATION_ERROR
+    assert root.error is not None
+    # restart after error is allowed once the fault is cleared
+    root.children[0].fail_on = set()
+    run(root.initialize())
+    assert root.status == LifecycleStatus.INITIALIZED
+
+
+def test_illegal_transition_raises(run):
+    c = Recorder("c", [])
+
+    async def main():
+        await c.start()
+        with pytest.raises(LifecycleException):
+            await c.initialize()  # cannot initialize while STARTED
+        await c.stop()
+
+    run(main())
+
+
+def test_progress_monitor_collects_steps(run):
+    log = []
+    steps = []
+    mon = LifecycleProgressMonitor(on_step=lambda c, s, t: steps.append((c, s)))
+    root = Recorder("root", log)
+    run(root.start(mon))
+    assert ("root", "started") in steps
+
+
+def test_state_tree(run):
+    log = []
+    root = Recorder("root", log)
+    root.add_child(Recorder("kid", log))
+    run(root.start())
+    tree = root.state_tree()
+    assert tree["status"] == "started"
+    assert tree["children"][0]["name"] == "kid"
+    run(root.stop())

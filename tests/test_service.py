@@ -1,0 +1,87 @@
+"""Service runtime + tenant engine lifecycle tests [SURVEY.md §3.1, §3.5]."""
+
+import asyncio
+
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.kernel.lifecycle import LifecycleStatus
+from sitewhere_tpu.kernel.service import (
+    Service,
+    ServiceRuntime,
+    TenantEngine,
+)
+
+
+class EchoEngine(TenantEngine):
+    async def _do_start(self, monitor):
+        self.started_for = self.tenant_id
+
+
+class EchoService(Service):
+    identifier = "echo"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant):
+        return EchoEngine(self, tenant)
+
+
+class GlobalService(Service):
+    identifier = "global"
+
+
+def test_runtime_starts_services_and_engines(run):
+    async def main():
+        rt = ServiceRuntime(InstanceSettings(instance_id="test"))
+        echo = rt.add_service(EchoService(rt))
+        rt.add_service(GlobalService(rt))
+        await rt.start()
+        assert rt.status == LifecycleStatus.STARTED
+
+        await rt.add_tenant(TenantConfig(tenant_id="acme"))
+        engine = echo.engine("acme")
+        assert engine.status == LifecycleStatus.STARTED
+        assert engine.started_for == "acme"
+        assert engine.tenant_topic("inbound-events") == \
+            "test.tenant.acme.inbound-events"
+
+        # update restarts the engine (fresh instance)
+        await rt.update_tenant(TenantConfig(tenant_id="acme", name="Acme v2"))
+        engine2 = echo.engine("acme")
+        assert engine2 is not engine
+        assert engine2.tenant.name == "Acme v2"
+
+        await rt.remove_tenant("acme")
+        assert "acme" not in echo.engines
+        await rt.stop()
+        assert rt.status == LifecycleStatus.STOPPED
+
+    run(main())
+
+
+def test_engines_bootstrap_for_preexisting_tenants(run):
+    async def main():
+        rt = ServiceRuntime(InstanceSettings(instance_id="test"))
+        rt.tenants["pre"] = TenantConfig(tenant_id="pre")
+        echo = rt.add_service(EchoService(rt))
+        await rt.start()
+        # engine manager bootstraps tenants known before start
+        for _ in range(200):
+            if "pre" in echo.engines and \
+                    echo.engines["pre"].status == LifecycleStatus.STARTED:
+                break
+            await asyncio.sleep(0.01)
+        assert echo.engine("pre").status == LifecycleStatus.STARTED
+        await rt.stop()
+
+    run(main())
+
+
+def test_api_and_wait_for_api(run):
+    async def main():
+        rt = ServiceRuntime(InstanceSettings(instance_id="test"))
+        rt.add_service(GlobalService(rt))
+        await rt.start()
+        api = await rt.wait_for_api("global")
+        assert api is rt.services["global"]
+        await rt.stop()
+
+    run(main())
