@@ -148,6 +148,11 @@ class LocationBatch:
         ts = np.frombuffer(mv, np.float64, n, o)
         return LocationBatch(ctx, dev, lat, lon, elev, ts)
 
+    def select(self, mask: np.ndarray) -> "LocationBatch":
+        return LocationBatch(self.ctx, self.device_index[mask],
+                             self.latitude[mask], self.longitude[mask],
+                             self.elevation[mask], self.ts[mask])
+
 
 @dataclass(slots=True)
 class AlertBatch:

@@ -130,8 +130,8 @@ class EventBus(LifecycleComponent):
         return sorted(self._topics)
 
     def end_offsets(self, topic: str) -> list[int]:
-        t = self._topics[topic]
-        return [p.end_offset for p in t.partitions]
+        self.create_topic(topic)
+        return [p.end_offset for p in self._topics[topic].partitions]
 
     # -- produce -----------------------------------------------------------
 

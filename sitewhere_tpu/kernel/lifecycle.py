@@ -236,6 +236,15 @@ class BackgroundTaskComponent(LifecycleComponent):
 
     async def _do_start(self, monitor: LifecycleProgressMonitor) -> None:
         self._task = asyncio.create_task(self._run(), name=self.path)
+        self._task.add_done_callback(self._on_task_done)
+
+    def _on_task_done(self, task: asyncio.Task) -> None:
+        # a crashed loop must be visible in health, not silently dead
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self._record_error(exc, LifecycleStatus.LIFECYCLE_ERROR)
 
     async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
         if self._task is not None:

@@ -214,6 +214,28 @@ class ServiceRuntime(LifecycleComponent):
                 raise TimeoutError(f"api {identifier} not available after {timeout}s")
             await asyncio.sleep(0.01)
 
+    async def wait_for_engine(self, identifier: str, tenant_id: str,
+                              timeout: float = 10.0) -> TenantEngine:
+        """Wait until `identifier`'s engine for `tenant_id` is STARTED.
+
+        Tenant-model-update broadcasts reach each service's engine manager
+        independently (reference: Kafka consumer groups, §3.5), so engine
+        start order across services is scheduler timing — consumers that
+        need a peer's engine must wait, exactly like the reference's
+        ApiChannel wait-for-available."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            svc = self.services.get(identifier)
+            if svc is not None:
+                eng = svc.engines.get(tenant_id)
+                if eng is not None and eng.status == LifecycleStatus.STARTED:
+                    return eng
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"{identifier} engine for tenant {tenant_id!r} "
+                    f"not available after {timeout}s")
+            await asyncio.sleep(0.01)
+
     # -- tenants -----------------------------------------------------------
 
     async def add_tenant(self, tenant: TenantConfig) -> None:

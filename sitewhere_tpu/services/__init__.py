@@ -1,0 +1,23 @@
+"""Domain services (reference layer L4, [SURVEY.md §2.2]).
+
+One module per reference microservice. All services share the in-proc
+runtime; cross-service traffic rides the topic bus (data plane) or
+`runtime.api()` (control/query plane), mirroring the reference's
+Kafka/gRPC discipline [SURVEY.md §1 "direction of dependencies"].
+"""
+
+from sitewhere_tpu.services.device_management import DeviceManagementService
+from sitewhere_tpu.services.asset_management import AssetManagementService
+from sitewhere_tpu.services.event_management import EventManagementService
+from sitewhere_tpu.services.event_sources import EventSourcesService
+from sitewhere_tpu.services.inbound_processing import InboundProcessingService
+from sitewhere_tpu.services.device_state import DeviceStateService
+
+__all__ = [
+    "DeviceManagementService",
+    "AssetManagementService",
+    "EventManagementService",
+    "EventSourcesService",
+    "InboundProcessingService",
+    "DeviceStateService",
+]

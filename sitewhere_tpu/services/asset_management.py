@@ -1,0 +1,28 @@
+"""asset-management service (reference: service-asset-management,
+[SURVEY.md §2.2]): asset types + assets referenced by assignments."""
+
+from __future__ import annotations
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+from sitewhere_tpu.persistence.memory import InMemoryAssetManagement
+
+
+class AssetManagementEngine(TenantEngine):
+    def __init__(self, service: "AssetManagementService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        self.spi = InMemoryAssetManagement()
+
+    def __getattr__(self, name):
+        return getattr(self.spi, name)
+
+
+class AssetManagementService(Service):
+    identifier = "asset-management"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> AssetManagementEngine:
+        return AssetManagementEngine(self, tenant)
+
+    def management(self, tenant_id: str) -> AssetManagementEngine:
+        return self.engine(tenant_id)  # type: ignore[return-value]

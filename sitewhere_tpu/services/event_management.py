@@ -1,0 +1,129 @@
+"""event-management service (reference: service-event-management,
+[SURVEY.md §2.2, §3.2]): persist inbound events to the event store and
+republish enriched/persisted events for downstream consumers
+(device-state, rule-processing/scoring, outbound-connectors).
+
+Persistence is the columnar TelemetryStore (vectorized ring scatter); the
+"enriched" record is the same columnar batch object — downstream
+consumers share it zero-copy (the reference re-marshals protobuf at this
+hop; that cost is deleted by design).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.batch import AlertBatch, LocationBatch, MeasurementBatch
+from sitewhere_tpu.domain.events import (
+    DeviceAlert,
+    DeviceCommandInvocation,
+    DeviceCommandResponse,
+    DeviceStateChange,
+)
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+from sitewhere_tpu.persistence.memory import InMemoryDeviceEventManagement
+
+logger = logging.getLogger(__name__)
+
+
+class EventManagementEngine(TenantEngine):
+    def __init__(self, service: "EventManagementService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        self.spi: InMemoryDeviceEventManagement = None  # type: ignore[assignment]
+        self.persister = EventPersister(self)
+        self.add_child(self.persister)
+        self._enriched_topic = self.tenant_topic(TopicNaming.OUTBOUND_ENRICHED)
+
+    async def _do_initialize(self, monitor) -> None:
+        # device-management's engine may not be up yet (independent
+        # tenant-update consumers) — wait, like the reference's ApiChannel
+        cfg = self.tenant.section("event-management", {})
+        dm = await self.runtime.wait_for_engine("device-management",
+                                                self.tenant_id)
+        self.spi = InMemoryDeviceEventManagement(
+            dm, history=cfg.get("history", 1024),
+            cold_retention=cfg.get("cold_retention", 100_000))
+
+    # -- API surface for other services / REST -----------------------------
+
+    async def add_command_invocations(
+            self, invocations: Sequence[DeviceCommandInvocation]):
+        """Persist invocations and publish them (command-delivery listens)."""
+        out = self.spi.add_command_invocations(invocations)
+        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        return out
+
+    async def add_alerts(self, alerts: Sequence[DeviceAlert]):
+        out = self.spi.add_alerts(alerts)
+        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.spi, name)
+
+
+class EventPersister(BackgroundTaskComponent):
+    """Consume inbound events → persist → republish enriched."""
+
+    def __init__(self, engine: EventManagementEngine):
+        super().__init__("event-persister")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        inbound_topic = engine.tenant_topic(TopicNaming.INBOUND_EVENTS)
+        enriched_topic = engine._enriched_topic
+        persisted = runtime.metrics.meter("event_management.events_persisted")
+        consumer = runtime.bus.subscribe(
+            inbound_topic, group=f"{tenant_id}.event-management")
+        spi = engine.spi
+        try:
+            while True:
+                for record in await consumer.poll(max_records=256, timeout=0.2):
+                    batch = record.value
+                    if isinstance(batch, MeasurementBatch):
+                        persisted.mark(spi.add_measurements(batch))
+                    elif isinstance(batch, LocationBatch):
+                        persisted.mark(spi.add_locations(batch))
+                    elif isinstance(batch, AlertBatch):
+                        persisted.mark(len(spi.add_alert_batch(batch)))
+                    elif isinstance(batch, list):  # cold per-event objects
+                        stored = 0
+                        for ev in batch:
+                            if isinstance(ev, DeviceAlert):
+                                spi.add_alerts([ev])
+                            elif isinstance(ev, DeviceCommandResponse):
+                                spi.add_command_responses([ev])
+                            elif isinstance(ev, DeviceStateChange):
+                                spi.add_state_changes([ev])
+                            else:
+                                logger.warning("event-mgmt: unpersistable cold"
+                                               " event %r", type(ev))
+                                continue
+                            stored += 1
+                        persisted.mark(stored)
+                    else:
+                        logger.warning("event-mgmt: unknown record %r", type(batch))
+                        continue
+                    await runtime.bus.produce(enriched_topic, batch,
+                                              key=record.key)
+                consumer.commit()
+        finally:
+            consumer.close()
+
+
+class EventManagementService(Service):
+    identifier = "event-management"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> EventManagementEngine:
+        return EventManagementEngine(self, tenant)
+
+    def management(self, tenant_id: str) -> EventManagementEngine:
+        return self.engine(tenant_id)  # type: ignore[return-value]

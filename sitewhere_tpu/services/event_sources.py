@@ -1,0 +1,279 @@
+"""event-sources service (reference: service-event-sources,
+[SURVEY.md §2.2]): protocol receivers + payload decoders → decoded-events
+topic.
+
+The reference hosts MQTT/CoAP/AMQP/ActiveMQ/AzureEventHub/WebSocket/Socket
+receivers and protobuf/JSON/Groovy decoders. Here:
+
+- receivers: `QueueEventReceiver` (in-proc; the simulator's feed and the
+  test double), `TcpEventReceiver` (length-prefixed SWB1 over TCP — the
+  gateway protocol), with the receiver Protocol open for MQTT adapters.
+- decoders: `Swb1Decoder` (columnar fast path — a few frombuffer views per
+  batch), `JsonDecoder` (token-addressed cold path: per-event JSON like the
+  reference's REST/MQTT JSON payloads, resolved to dense indices here).
+
+Decoded batches are produced to the tenant's decoded-events topic; failed
+decodes go to the failed-decode topic [SURVEY.md §3.2].
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional, Protocol
+
+import numpy as np
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.batch import (
+    BatchContext,
+    LocationBatch,
+    MeasurementBatch,
+    RegistrationBatch,
+)
+from sitewhere_tpu.domain.batch import MAGIC, MSG_LOCATIONS, MSG_MEASUREMENTS, _HEADER
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent, LifecycleComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+
+logger = logging.getLogger(__name__)
+
+
+class EventDecoder(Protocol):
+    """(reference: IDeviceEventDecoder)"""
+
+    def decode(self, payload: bytes, ctx: BatchContext) -> list: ...
+
+
+class Swb1Decoder:
+    """Columnar fast path (reference analog: ProtobufDeviceEventDecoder)."""
+
+    def decode(self, payload: bytes, ctx: BatchContext) -> list:
+        magic, msg_type, _flags, _n = _HEADER.unpack_from(payload, 0)
+        if magic != MAGIC:
+            raise ValueError("bad SWB1 magic")
+        if msg_type == MSG_MEASUREMENTS:
+            return [MeasurementBatch.decode(payload, ctx)]
+        if msg_type == MSG_LOCATIONS:
+            return [LocationBatch.decode(payload, ctx)]
+        raise ValueError(f"unknown SWB1 message type {msg_type}")
+
+
+class JsonDecoder:
+    """Token-addressed JSON payloads (reference analog:
+    JsonDeviceRequestDecoder). Shapes:
+
+      {"requests": [{"type": "measurement", "device": "tok", "mtype": 0,
+                     "value": 1.2, "ts": ...},
+                    {"type": "location", "device": "tok", "lat": .., "lon": ..},
+                    {"type": "registration", "device": "tok",
+                     "deviceType": "ttok"}]}
+
+    Device tokens are resolved to dense indices via the device-management
+    engine; unknown tokens become registration requests (auto-registration
+    path, [SURVEY.md §2.2 device-registration]).
+    """
+
+    def __init__(self, resolve_tokens):
+        self._resolve = resolve_tokens  # Sequence[str] -> list[int]
+
+    def decode(self, payload: bytes, ctx: BatchContext) -> list:
+        doc = json.loads(payload)
+        requests = doc.get("requests", [doc] if doc else [])
+        meas, locs, out = [], [], []
+        for r in requests:
+            t = r.get("type", "measurement")
+            if t == "measurement":
+                meas.append(r)
+            elif t == "location":
+                locs.append(r)
+            elif t == "registration":
+                out.append(RegistrationBatch(
+                    ctx, [r["device"]], r.get("deviceType", ""),
+                    area_token=r.get("area"), metadata=r.get("metadata", {})))
+            else:
+                raise ValueError(f"unknown request type {t!r}")
+        now = time.time()
+        if meas:
+            idx = self._resolve([r["device"] for r in meas])
+            known = [(i, r) for i, r in zip(idx, meas) if i >= 0]
+            for i, r in zip(idx, meas):
+                if i < 0:
+                    out.append(RegistrationBatch(ctx, [r["device"]], ""))
+            if known:
+                out.append(MeasurementBatch(
+                    ctx,
+                    np.asarray([i for i, _ in known], np.uint32),
+                    np.asarray([r.get("mtype", 0) for _, r in known], np.uint16),
+                    np.asarray([r.get("value", 0.0) for _, r in known], np.float32),
+                    np.asarray([r.get("ts", now) for _, r in known], np.float64)))
+        if locs:
+            idx = self._resolve([r["device"] for r in locs])
+            known = [(i, r) for i, r in zip(idx, locs) if i >= 0]
+            for i, r in zip(idx, locs):
+                if i < 0:  # unknown token → auto-registration, like measurements
+                    out.append(RegistrationBatch(ctx, [r["device"]], ""))
+            if known:
+                out.append(LocationBatch(
+                    ctx,
+                    np.asarray([i for i, _ in known], np.uint32),
+                    np.asarray([r.get("lat", 0.0) for _, r in known]),
+                    np.asarray([r.get("lon", 0.0) for _, r in known]),
+                    np.asarray([r.get("elevation", 0.0) for _, r in known],
+                               np.float32),
+                    np.asarray([r.get("ts", now) for _, r in known], np.float64)))
+        return out
+
+
+class QueueEventReceiver(BackgroundTaskComponent):
+    """In-proc receiver: payloads arrive on an asyncio.Queue
+    (reference analog: an InboundEventReceiver; doubles as the test/bench
+    ingress and the simulator's sink)."""
+
+    def __init__(self, name: str, engine: "EventSourcesEngine",
+                 decoder: EventDecoder, maxsize: int = 1024):
+        super().__init__(name)
+        self.engine = engine
+        self.decoder = decoder
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def submit(self, payload: bytes) -> None:
+        await self.queue.put(payload)
+
+    def submit_nowait(self, payload: bytes) -> None:
+        self.queue.put_nowait(payload)
+
+    async def _run(self) -> None:
+        while True:
+            payload = await self.queue.get()
+            await self.engine.process_payload(payload, self.name, self.decoder)
+
+
+class TcpEventReceiver(BackgroundTaskComponent):
+    """Length-prefixed frames over TCP (u32 length + SWB1 body) — the
+    gateway ingestion protocol (reference analog: the socket receiver)."""
+
+    MAX_FRAME = 16 * 1024 * 1024  # hostile length prefixes can't buffer GiBs
+
+    def __init__(self, name: str, engine: "EventSourcesEngine",
+                 decoder: EventDecoder, host: str = "127.0.0.1", port: int = 0,
+                 max_frame: Optional[int] = None):
+        super().__init__(name)
+        self.engine = engine
+        self.decoder = decoder
+        self.host, self.port = host, port
+        self.max_frame = max_frame or self.MAX_FRAME
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def _do_start(self, monitor) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                header = await reader.readexactly(4)
+                length = int.from_bytes(header, "little")
+                if length > self.max_frame:
+                    logger.warning("%s: frame length %d exceeds max %d, dropping"
+                                   " connection", self.name, length, self.max_frame)
+                    break
+                payload = await reader.readexactly(length)
+                await self.engine.process_payload(payload, self.name, self.decoder)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _run(self) -> None:  # server runs itself; nothing to poll
+        await asyncio.Event().wait()
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+class EventSourcesEngine(TenantEngine):
+    """Per-tenant receiver fleet + decode → decoded-events topic."""
+
+    def __init__(self, service: "EventSourcesService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        self._decoded_topic = self.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED)
+        self._failed_topic = self.tenant_topic(TopicNaming.EVENT_SOURCE_FAILED)
+        self._events_in = service.metrics.meter("event_sources.events_received")
+        self._decode_failures = service.metrics.counter("event_sources.decode_failures")
+        self.receivers: list[LifecycleComponent] = []
+        cfg = tenant.section("event-sources", {"receivers": [{"kind": "queue",
+                                                              "decoder": "swb1",
+                                                              "name": "default"}]})
+        for rc in cfg.get("receivers", []):
+            self.add_receiver(rc)
+
+    def _make_decoder(self, kind: str) -> EventDecoder:
+        if kind == "swb1":
+            return Swb1Decoder()
+        if kind == "json":
+            dm = self.runtime.api("device-management")
+            tenant_id = self.tenant_id
+
+            def resolve(tokens):
+                return dm.management(tenant_id).tokens_to_indices(tokens)
+
+            return JsonDecoder(resolve)
+        raise ValueError(f"unknown decoder {kind!r}")
+
+    def add_receiver(self, cfg: dict) -> LifecycleComponent:
+        decoder = self._make_decoder(cfg.get("decoder", "swb1"))
+        kind = cfg.get("kind", "queue")
+        name = cfg.get("name", f"{kind}-{len(self.receivers)}")
+        if kind == "queue":
+            r = QueueEventReceiver(name, self, decoder,
+                                   maxsize=cfg.get("maxsize", 1024))
+        elif kind == "tcp":
+            r = TcpEventReceiver(name, self, decoder,
+                                 host=cfg.get("host", "127.0.0.1"),
+                                 port=cfg.get("port", 0))
+        else:
+            raise ValueError(f"unknown receiver kind {kind!r}")
+        self.receivers.append(r)
+        self.add_child(r)
+        return r
+
+    def receiver(self, name: str):
+        for r in self.receivers:
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+    async def process_payload(self, payload: bytes, source: str,
+                              decoder: EventDecoder) -> None:
+        ctx = BatchContext(tenant_id=self.tenant_id, source=source)
+        try:
+            batches = decoder.decode(payload, ctx)
+        except Exception as exc:  # noqa: BLE001 - failed decode is data, not a crash
+            self._decode_failures.inc()
+            await self.runtime.bus.produce(
+                self._failed_topic, {"payload": payload, "error": repr(exc),
+                                     "source": source})
+            return
+        for batch in batches:
+            n = len(batch)
+            if n:
+                self._events_in.mark(n)
+            # keyed by source: one source's stream stays partition-ordered
+            # through the whole pipeline (Kafka's ordering model)
+            await self.runtime.bus.produce(self._decoded_topic, batch, key=source)
+
+
+class EventSourcesService(Service):
+    identifier = "event-sources"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> EventSourcesEngine:
+        return EventSourcesEngine(self, tenant)

@@ -1,0 +1,94 @@
+"""inbound-processing service (reference: service-inbound-processing,
+[SURVEY.md §2.2, §3.2]): consume decoded events, validate device +
+assignment, split off unregistered devices, forward for persistence.
+
+Reference hot-loop note [SURVEY.md §3.2]: upstream pays a per-event gRPC
+`getDeviceByToken` to device-management here — its latency killer. The
+TPU-first replacement: decoded batches carry dense device indices, and
+validation is ONE vectorized mask gather per batch against the
+device-management engine's registration mask. Unknown devices are split
+into the unregistered-device topic (consumed by device-registration) with
+the same at-least-once semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.batch import (
+    LocationBatch,
+    MeasurementBatch,
+    RegistrationBatch,
+)
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+
+logger = logging.getLogger(__name__)
+
+
+class InboundProcessingEngine(TenantEngine):
+    def __init__(self, service: "InboundProcessingService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        self.processor = InboundProcessor(self)
+        self.add_child(self.processor)
+
+
+class InboundProcessor(BackgroundTaskComponent):
+    def __init__(self, engine: InboundProcessingEngine):
+        super().__init__("inbound-processor")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        # engines start in broadcast order across services — wait, don't race
+        dm = await runtime.wait_for_engine("device-management", tenant_id)
+        dm_service = runtime.services["device-management"]
+        decoded_topic = engine.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED)
+        inbound_topic = engine.tenant_topic(TopicNaming.INBOUND_EVENTS)
+        unregistered_topic = engine.tenant_topic(TopicNaming.UNREGISTERED_DEVICES)
+        metrics = runtime.metrics
+        processed = metrics.meter("inbound.events_processed")
+        dropped = metrics.counter("inbound.events_unregistered")
+        consumer = runtime.bus.subscribe(
+            decoded_topic, group=f"{tenant_id}.inbound-processing")
+        try:
+            while True:
+                # re-resolve each round: a tenant update swaps the dm engine
+                dm = dm_service.engines.get(tenant_id, dm)
+                for record in await consumer.poll(max_records=256, timeout=0.2):
+                    batch = record.value
+                    if isinstance(batch, (MeasurementBatch, LocationBatch)):
+                        mask = dm.registered_mask(batch.device_index)
+                        n_bad = int((~mask).sum())
+                        if n_bad:
+                            dropped.inc(n_bad)
+                            bad = batch.device_index[~mask]
+                            await runtime.bus.produce(
+                                unregistered_topic,
+                                {"device_indices": bad, "ctx": batch.ctx})
+                            batch = batch.select(mask)
+                        if len(batch):
+                            processed.mark(len(batch))
+                            await runtime.bus.produce(inbound_topic, batch,
+                                                      key=record.key)
+                    elif isinstance(batch, RegistrationBatch):
+                        await runtime.bus.produce(unregistered_topic, batch)
+                    else:
+                        logger.warning("inbound: unknown record %r", type(batch))
+                consumer.commit()
+        finally:
+            consumer.close()
+
+
+class InboundProcessingService(Service):
+    identifier = "inbound-processing"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> InboundProcessingEngine:
+        return InboundProcessingEngine(self, tenant)

@@ -1,0 +1,3 @@
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+__all__ = ["DeviceSimulator", "SimConfig"]

@@ -1,0 +1,94 @@
+"""Device simulator: synthetic telemetry fleets (config 1 [BASELINE.json]).
+
+The reference has no in-repo load generator ([SURVEY.md §4]: community
+used external JMeter/MQTT rigs); the rebuild makes the simulator a
+first-class fixture — it is both the e2e test harness and the bench load
+source.
+
+Telemetry model (vectorized over the whole fleet per tick):
+  value[d] = base[d] + amp[d]·sin(2π·(t/period[d]) + phase[d]) + noise
+with a configurable fraction of injected anomalies (spikes / stuck-at /
+drift) whose ground-truth mask is returned alongside — scoring tests
+measure detection against it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    num_devices: int = 1000
+    base_mean: float = 21.0        # °C
+    base_spread: float = 3.0
+    amplitude: float = 2.0
+    period_s: float = 3600.0
+    noise_std: float = 0.15
+    anomaly_rate: float = 0.0      # per-event probability of a spike
+    anomaly_magnitude: float = 8.0 # added to value (in noise-std units ≫ 1)
+    seed: int = 7
+
+
+class DeviceSimulator:
+    """Stateful fleet simulator; each tick yields one columnar batch."""
+
+    def __init__(self, cfg: SimConfig, tenant_id: str = "default"):
+        self.cfg = cfg
+        self.tenant_id = tenant_id
+        rng = np.random.default_rng(cfg.seed)
+        n = cfg.num_devices
+        self.base = (cfg.base_mean
+                     + cfg.base_spread * rng.standard_normal(n)).astype(np.float32)
+        self.phase = rng.uniform(0, 2 * np.pi, n).astype(np.float32)
+        self.period = (cfg.period_s * rng.uniform(0.8, 1.25, n)).astype(np.float32)
+        self.amp = (cfg.amplitude * rng.uniform(0.5, 1.5, n)).astype(np.float32)
+        self.rng = rng
+        self._device_index = np.arange(n, dtype=np.uint32)
+        self._mtype = np.zeros(n, dtype=np.uint16)
+
+    def tick(self, t: float | None = None,
+             devices: np.ndarray | None = None) -> tuple[MeasurementBatch, np.ndarray]:
+        """One reading per device → (batch, ground-truth anomaly mask)."""
+        cfg = self.cfg
+        t = time.time() if t is None else t
+        idx = self._device_index if devices is None else devices.astype(np.uint32)
+        d = idx.astype(np.int64)
+        clean = (self.base[d]
+                 + self.amp[d] * np.sin(2 * np.pi * (t / self.period[d])
+                                        + self.phase[d])
+                 + cfg.noise_std * self.rng.standard_normal(d.size).astype(np.float32))
+        anomaly = np.zeros(d.size, dtype=bool)
+        if cfg.anomaly_rate > 0:
+            anomaly = self.rng.random(d.size) < cfg.anomaly_rate
+            sign = self.rng.choice(np.asarray([-1.0, 1.0], np.float32), d.size)
+            clean = clean + anomaly * sign * cfg.anomaly_magnitude
+        batch = MeasurementBatch(
+            BatchContext(tenant_id=self.tenant_id, source="simulator"),
+            idx,
+            self._mtype[: d.size] if devices is None else np.zeros(d.size, np.uint16),
+            clean.astype(np.float32),
+            np.full(d.size, t, np.float64),
+        )
+        return batch, anomaly
+
+    def history(self, length: int, dt_s: float = 60.0,
+                end_time: float | None = None) -> np.ndarray:
+        """Backfill: `[num_devices, length]` of clean history (train data)."""
+        end_time = time.time() if end_time is None else end_time
+        ts = end_time - dt_s * np.arange(length - 1, -1, -1)
+        out = np.empty((self.cfg.num_devices, length), np.float32)
+        for j, t in enumerate(ts):
+            b, _ = self.tick(float(t))
+            out[:, j] = b.value
+        return out
+
+    def payload(self, t: float | None = None) -> tuple[bytes, np.ndarray]:
+        """One tick encoded as an SWB1 wire payload (gateway emulation)."""
+        batch, truth = self.tick(t)
+        return batch.encode(), truth
