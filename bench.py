@@ -1,0 +1,161 @@
+"""Benchmark: full-pipeline scored-events throughput + p99 latency.
+
+The judge's metric [BASELINE.json]: device-events/sec scored and p99
+per-event inference latency. This drives the REAL pipeline — simulator
+payloads → event-sources (SWB1 decode) → inbound (mask) → event-mgmt
+(columnar persist) → rule-processing (TPU-scored) — and reports the
+sustained scored-events rate and end-to-end p99 (stamped at receiver
+arrival).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+vs_baseline is value / 1e6 (the north-star ≥1M events/s target; the
+reference publishes no numbers — BASELINE.md).
+
+Usage: python bench.py [--model lstm|zscore] [--devices N] [--seconds S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+async def run_bench(args) -> dict:
+    import os
+
+    import jax
+    import numpy as np
+
+    # persistent compile cache: repeat bench runs skip the 20-40s first-compile
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from sitewhere_tpu.config import InstanceSettings, TenantConfig
+    from sitewhere_tpu.domain.model import DeviceType
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.services import (
+        DeviceManagementService,
+        DeviceStateService,
+        EventManagementService,
+        EventSourcesService,
+        InboundProcessingService,
+        RuleProcessingService,
+    )
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    rt = ServiceRuntime(InstanceSettings(instance_id="bench"))
+    for cls in (DeviceManagementService, EventSourcesService,
+                InboundProcessingService, EventManagementService,
+                DeviceStateService, RuleProcessingService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    await rt.add_tenant(TenantConfig(tenant_id="bench", sections={
+        "event-management": {"history": args.history},
+        "rule-processing": {
+            "model": args.model,
+            "model_config": {"window": args.window},
+            "threshold": 6.0,
+            "batch_window_ms": args.window_ms,
+            "buckets": [args.devices],  # fleet-sized bucket: 1 flush = 1 XLA call
+        },
+    }))
+    dm = rt.api("device-management").management("bench")
+    dm.bootstrap_fleet(DeviceType(token="thermo", name="Thermometer"),
+                       args.devices)
+
+    em = rt.api("event-management").management("bench")
+    sim = DeviceSimulator(SimConfig(num_devices=args.devices,
+                                    anomaly_rate=0.001,
+                                    anomaly_magnitude=12.0),
+                          tenant_id="bench")
+
+    # warm history directly into the store (not measured)
+    for k in range(args.window + 4):
+        batch, _ = sim.tick(t=60.0 * k)
+        em.telemetry.append_measurements(batch)
+
+    receiver = rt.api("event-sources").engine("bench").receiver("default")
+    session = rt.api("rule-processing").engine("bench").session
+    scored_meter = session.scored_meter
+    # wait for background warmup (bucket compiles) before measuring
+    t_warm = time.monotonic()
+    while not session.ready:
+        await asyncio.sleep(0.1)
+        if time.monotonic() - t_warm > 300:
+            raise TimeoutError("scoring warmup did not finish in 300s")
+
+    # warmup pass through the whole pipeline (jit already compiled in
+    # engine start; this warms caches end to end)
+    t_base = 60.0 * (args.window + 4)
+    for k in range(3):
+        await receiver.submit(sim.payload(t=t_base + k)[0])
+    await asyncio.sleep(0.5)
+
+    # measured run: feed as fast as the pipeline absorbs (bounded queue
+    # provides backpressure); latency stats reset for the measured window
+    lat_hist = session.latency
+    lat_hist.counts = [0] * len(lat_hist.counts)
+    lat_hist.count = 0
+    lat_hist.sum = 0.0
+    lat_hist._max = 0.0
+
+    t0 = time.monotonic()
+    k = 0
+    sent = 0
+    while time.monotonic() - t0 < args.seconds:
+        payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
+        await receiver.submit(payload)
+        sent += args.devices
+        k += 1
+    # drain
+    deadline = time.monotonic() + 10.0
+    while lat_hist.count < sent and time.monotonic() < deadline:
+        await asyncio.sleep(0.05)
+    elapsed = time.monotonic() - t0
+
+    scored = lat_hist.count
+    rate = scored / elapsed if elapsed > 0 else 0.0
+    p99 = lat_hist.quantile(0.99)
+    p50 = lat_hist.quantile(0.50)
+    await rt.stop()
+
+    import jax
+    return {
+        "metric": "pipeline_scored_events_per_sec",
+        "value": round(rate, 1),
+        "unit": "events/s",
+        "vs_baseline": round(rate / 1_000_000, 4),
+        "p99_ms": round(p99 * 1e3, 3),
+        "p50_ms": round(p50 * 1e3, 3),
+        "events_scored": int(scored),
+        "seconds": round(elapsed, 2),
+        "model": args.model,
+        "devices": args.devices,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="lstm", choices=["lstm", "zscore"])
+    parser.add_argument("--devices", type=int, default=16384)
+    parser.add_argument("--seconds", type=float, default=10.0)
+    parser.add_argument("--window", type=int, default=64)
+    parser.add_argument("--window-ms", type=float, default=2.0)
+    parser.add_argument("--history", type=int, default=256)
+    args = parser.parse_args()
+    result = asyncio.run(run_bench(args))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -274,7 +274,15 @@ class BusConsumer:
 
     async def poll(self, *, max_records: int = 512,
                    timeout: float = 1.0) -> list[TopicRecord]:
-        """Wait up to `timeout` for records on assigned partitions."""
+        """Wait up to `timeout` for records on assigned partitions.
+
+        Always yields to the event loop at least once: asyncio's fast
+        paths (uncontended locks, non-empty queues) never suspend, so
+        without this a saturated consumer loop monopolizes the loop and
+        starves every other service for seconds (observed: wedged
+        scoring under flood).
+        """
+        await asyncio.sleep(0)
         records = self.poll_nowait(max_records)
         if records or self._closed:
             return records

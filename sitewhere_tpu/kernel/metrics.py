@@ -74,6 +74,25 @@ class Histogram:
         if value > self._max:
             self._max = value
 
+    def observe_array(self, values) -> None:
+        """Vectorized bulk observe (per-event latency at 1M events/s can't
+        afford a Python loop)."""
+        import numpy as np
+
+        values = np.asarray(values, np.float64)
+        if values.size == 0:
+            return
+        idx = np.searchsorted(self.buckets, values, side="left")
+        binned = np.bincount(idx, minlength=len(self.counts))
+        for i, c in enumerate(binned):
+            if c:
+                self.counts[i] += int(c)
+        self.count += values.size
+        self.sum += float(values.sum())
+        m = float(values.max())
+        if m > self._max:
+            self._max = m
+
     def quantile(self, q: float) -> float:
         """Upper-bound estimate of the q-quantile from bucket counts."""
         if self.count == 0:

@@ -238,13 +238,13 @@ class ServiceRuntime(LifecycleComponent):
 
     # -- tenants -----------------------------------------------------------
 
-    async def add_tenant(self, tenant: TenantConfig) -> None:
+    async def add_tenant(self, tenant: TenantConfig, *, timeout: float = 60.0) -> None:
         """Register a tenant and broadcast creation (reference: §3.5)."""
         self.tenants[tenant.tenant_id] = tenant
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "created", "tenant": tenant}, key=tenant.tenant_id)
-        await self._await_engines(tenant.tenant_id)
+        await self._await_engines(tenant.tenant_id, timeout=timeout)
 
     async def update_tenant(self, tenant: TenantConfig) -> None:
         self.tenants[tenant.tenant_id] = tenant
@@ -263,7 +263,8 @@ class ServiceRuntime(LifecycleComponent):
         await self._await_engines(tenant_id, present=False)
 
     async def _await_engines(self, tenant_id: str, *, present: bool = True,
-                             timeout: float = 10.0) -> None:
+                             timeout: float = 60.0) -> None:
+        # default is generous: engine start may include TPU warm-up compiles
         """Block until every multitenant service has (or drops) the engine."""
         deadline = asyncio.get_event_loop().time() + timeout
         multitenant = [s for s in self.services.values()

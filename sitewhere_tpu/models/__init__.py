@@ -1,0 +1,25 @@
+"""JAX model zoo — the TPU compute plane (reference layer L6 is [ABSENT]:
+SiteWhere has no models; these are the north star's additions
+[BASELINE.json configs 2/3/5], mounted at the rule-processing hook
+[SURVEY.md §1 L5]).
+
+All models follow one functional contract (pure JAX, pytree params):
+
+    init(rng, cfg) -> params
+    score(params, x, valid) -> scores          # [B, W] -> [B]
+    loss(params, x, valid) -> scalar           # self-supervised training
+
+so the scoring server, trainer, and per-tenant stacking (`vmap` over a
+leading tenant axis) treat every model identically. bfloat16 matmuls on
+the MXU; float32 accumulations.
+"""
+
+from sitewhere_tpu.models.lstm import LstmConfig, LstmAnomalyModel
+from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
+from sitewhere_tpu.models.registry import MODEL_REGISTRY, build_model
+
+__all__ = [
+    "LstmConfig", "LstmAnomalyModel",
+    "ZScoreConfig", "ZScoreModel",
+    "MODEL_REGISTRY", "build_model",
+]

@@ -1,0 +1,142 @@
+"""LSTM anomaly detector (config 2 [BASELINE.json]).
+
+Self-supervised next-step forecaster over a device's recent telemetry
+window; the anomaly score is the normalized one-step-ahead prediction
+error at the newest point. Replaces the reference's CPU Siddhi/Groovy
+rule evaluation at the same hook point [SURVEY.md §1 L5, §3.2].
+
+TPU-first details:
+- pure functional: params are a pytree; `score`/`loss` are jit/vmap/pjit
+  friendly (static shapes, `lax.scan` over time, no Python branching).
+- matmuls in bfloat16 (MXU), state/accumulation in float32.
+- per-window normalization makes one set of weights serve heterogeneous
+  fleets (different baselines/scales per device).
+- the same `score` vmaps over a stacked leading tenant axis for
+  per-tenant multiplexing without recompiles (config 4; SURVEY.md §7
+  hard part b).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class LstmConfig:
+    window: int = 64          # input history length W
+    hidden: int = 64
+    layers: int = 1
+    compute_dtype: Any = jnp.bfloat16
+    score_clip: float = 50.0  # scores are z-like; clip insanity
+
+
+def _dense_init(rng, n_in, n_out, scale=None):
+    scale = scale if scale is not None else (1.0 / np.sqrt(n_in))
+    w_key, _ = jax.random.split(rng)
+    return {
+        "w": jax.random.normal(w_key, (n_in, n_out), jnp.float32) * scale,
+        "b": jnp.zeros((n_out,), jnp.float32),
+    }
+
+
+class LstmAnomalyModel:
+    """Functional LSTM forecaster. Instances hold config only — params
+    are always passed explicitly (pjit/vmap need that)."""
+
+    name = "lstm"
+
+    def __init__(self, cfg: LstmConfig = LstmConfig()):
+        self.cfg = cfg
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        params = {}
+        keys = jax.random.split(rng, cfg.layers + 1)
+        in_dim = 1
+        for layer in range(cfg.layers):
+            # fused gate weights: [in+hidden, 4*hidden] (i, f, g, o)
+            params[f"lstm{layer}"] = {
+                "wx": jax.random.normal(keys[layer], (in_dim, 4 * cfg.hidden),
+                                        jnp.float32) / np.sqrt(in_dim),
+                "wh": jax.random.normal(jax.random.fold_in(keys[layer], 1),
+                                        (cfg.hidden, 4 * cfg.hidden),
+                                        jnp.float32) / np.sqrt(cfg.hidden),
+                # forget-gate bias +1 (standard stabilization)
+                "b": jnp.concatenate([
+                    jnp.zeros((cfg.hidden,)), jnp.ones((cfg.hidden,)),
+                    jnp.zeros((2 * cfg.hidden,))]).astype(jnp.float32),
+            }
+            in_dim = cfg.hidden
+        params["head"] = _dense_init(keys[-1], cfg.hidden, 1)
+        return params
+
+    # -- forward -----------------------------------------------------------
+
+    def _normalize(self, x: jax.Array, valid: jax.Array):
+        """Per-window masked mean/std (padding slots excluded)."""
+        n = jnp.maximum(valid.sum(-1, keepdims=True), 1.0)
+        mu = (x * valid).sum(-1, keepdims=True) / n
+        var = (((x - mu) * valid) ** 2).sum(-1, keepdims=True) / n
+        sd = jnp.sqrt(var + 1e-6)
+        return (x - mu) / sd, mu, sd
+
+    def _predictions(self, params: dict, xn: jax.Array) -> jax.Array:
+        """One-step-ahead predictions for steps 1..W-1.  xn: [B, W] → [B, W-1]."""
+        cfg = self.cfg
+        B = xn.shape[0]
+        cdt = cfg.compute_dtype
+        inputs = xn[:, :-1, None].astype(cdt)             # [B, W-1, 1]
+
+        def layer_scan(layer_params, seq):
+            wx = layer_params["wx"].astype(cdt)
+            wh = layer_params["wh"].astype(cdt)
+            b = layer_params["b"].astype(jnp.float32)
+            H = wh.shape[0]
+
+            def step(carry, x_t):
+                h, c = carry
+                gates = (x_t @ wx).astype(jnp.float32) \
+                    + (h.astype(cdt) @ wh).astype(jnp.float32) + b
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h = jax.nn.sigmoid(o) * jnp.tanh(c)
+                return (h, c), h
+
+            h0 = jnp.zeros((seq.shape[0], H), jnp.float32)
+            (_, _), hs = jax.lax.scan(step, (h0, h0),
+                                      jnp.swapaxes(seq, 0, 1))
+            return jnp.swapaxes(hs, 0, 1)                 # [B, T, H]
+
+        seq = inputs
+        for layer in range(cfg.layers):
+            seq = layer_scan(params[f"lstm{layer}"], seq).astype(cdt)
+        head = params["head"]
+        preds = (seq.astype(jnp.float32) @ head["w"] + head["b"])[..., 0]
+        return preds                                       # [B, W-1]
+
+    def score(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
+        """Anomaly score per row: normalized |forecast error| at the newest
+        step. x: [B, W] raw values; valid: [B, W] bool. → [B] float32."""
+        xn, _, _ = self._normalize(x, valid.astype(jnp.float32))
+        preds = self._predictions(params, xn)
+        err = jnp.abs(preds[:, -1] - xn[:, -1])
+        # rows with too little history can't be judged → score 0
+        enough = valid.sum(-1) >= max(8, self.cfg.window // 8)
+        return jnp.clip(jnp.where(enough, err, 0.0), 0.0, self.cfg.score_clip)
+
+    def loss(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
+        """Masked next-step MSE over the window (self-supervised)."""
+        v = valid.astype(jnp.float32)
+        xn, _, _ = self._normalize(x, v)
+        preds = self._predictions(params, xn)
+        target = xn[:, 1:]
+        mask = v[:, 1:] * v[:, :-1]
+        se = (preds - target) ** 2 * mask
+        return se.sum() / jnp.maximum(mask.sum(), 1.0)

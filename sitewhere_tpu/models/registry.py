@@ -1,0 +1,32 @@
+"""Model registry: name → (config class, model class).
+
+The tenant config's `rule-processing` section selects a model by name
+(the way the reference's tenant config selects Groovy scripts / Siddhi
+queries per tenant, [SURVEY.md §5.6]).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from sitewhere_tpu.models.lstm import LstmAnomalyModel, LstmConfig
+from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
+
+MODEL_REGISTRY: dict[str, tuple[type, type]] = {
+    "lstm": (LstmConfig, LstmAnomalyModel),
+    "zscore": (ZScoreConfig, ZScoreModel),
+}
+
+
+def register_model(name: str, cfg_cls: type, model_cls: type) -> None:
+    MODEL_REGISTRY[name] = (cfg_cls, model_cls)
+
+
+def build_model(name: str, **cfg_overrides: Any):
+    """Instantiate a model by registry name with config overrides."""
+    try:
+        cfg_cls, model_cls = MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r} (known: {sorted(MODEL_REGISTRY)})") from None
+    return model_cls(cfg_cls(**cfg_overrides))

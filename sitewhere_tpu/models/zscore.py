@@ -1,0 +1,49 @@
+"""Rolling z-score detector: the training-free baseline rule.
+
+Capability analog of the reference's threshold-style Siddhi queries
+([SURVEY.md §2.2 rule-processing]): score = |newest − mean(history)| / std.
+Works from the first window with no training, so a fresh tenant gets
+anomaly detection immediately; the LSTM takes over after its first
+training run (model hot-swap, SURVEY.md §7 step 4).
+
+Same functional contract as every model — `init/score/loss` — so the
+scoring server treats it identically (its params are an empty pytree).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ZScoreConfig:
+    window: int = 64
+    score_clip: float = 50.0
+    min_history: int = 8
+
+
+class ZScoreModel:
+    name = "zscore"
+
+    def __init__(self, cfg: ZScoreConfig = ZScoreConfig()):
+        self.cfg = cfg
+
+    def init(self, rng: jax.Array) -> dict:
+        return {}  # stateless
+
+    def score(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
+        v = valid.astype(jnp.float32)
+        hist_v = v[:, :-1]
+        n = jnp.maximum(hist_v.sum(-1), 1.0)
+        mu = (x[:, :-1] * hist_v).sum(-1) / n
+        var = (((x[:, :-1] - mu[:, None]) * hist_v) ** 2).sum(-1) / n
+        sd = jnp.sqrt(var + 1e-6)
+        score = jnp.abs(x[:, -1] - mu) / sd
+        enough = v.sum(-1) >= self.cfg.min_history
+        return jnp.clip(jnp.where(enough, score, 0.0), 0.0, self.cfg.score_clip)
+
+    def loss(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
+        return jnp.zeros(())  # nothing to train

@@ -1,0 +1,22 @@
+"""Parallelism: device meshes, shardings, per-tenant model stacking.
+
+The reference has no collective-compute plane at all ([SURVEY.md §2.4]:
+Kafka consumer groups are its only parallelism). This package is the
+rebuild's TPU-native distributed layer:
+
+- `mesh.py`: mesh construction over real or virtual devices, standard
+  ("data", "model") axes, sharding helpers. Collectives are XLA's — the
+  design never hand-codes NCCL-style point-to-point [SURVEY.md §5.8].
+- `tenant_stack.py`: per-tenant model multiplexing — stacked params with
+  tenant-index dispatch, vmap'd scoring, tenant-axis sharding over the
+  mesh (config 4 [BASELINE.json]).
+"""
+
+from sitewhere_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_batch"]

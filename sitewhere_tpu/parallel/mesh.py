@@ -1,0 +1,69 @@
+"""Mesh construction + sharding helpers (SPMD foundation).
+
+Axis convention (the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives):
+
+- `data`: batch-parallel axis. Training batches shard here; gradient
+  allreduce rides ICI automatically (psum inserted by XLA under pjit).
+- `model`: tensor/tenant-parallel axis. v1 uses it for per-tenant stacked
+  params (tenant shards, config 4); TFT/GNN tensor sharding lands on the
+  same axis later so the mesh shape is stable across models.
+
+Multi-host: `jax.distributed.initialize` is the entry (DCN between
+slices); within a process the same helpers work on any device set,
+including the CPU host-platform mesh used by tests and the driver's
+`dryrun_multichip` [task contract].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (data, model) mesh over `devices` (default: all)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch) dim over `data`, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def tenant_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the leading (tenant) dim over `model`."""
+    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_batch(mesh: Mesh, *arrays: jax.Array | np.ndarray):
+    """Pad each array's leading dim to a multiple of the data axis and
+    place it sharded. Returns (arrays..., original_n)."""
+    d = mesh.shape[DATA_AXIS]
+    out = []
+    n = arrays[0].shape[0]
+    padded = ((n + d - 1) // d) * d
+    for a in arrays:
+        if padded != n:
+            pad_width = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
+            a = np.pad(np.asarray(a), pad_width)
+        out.append(jax.device_put(a, batch_sharding(mesh, a.ndim)))
+    return (*out, n)

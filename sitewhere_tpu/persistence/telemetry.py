@@ -203,6 +203,11 @@ class TelemetryStore:
             self.locations.append(batch)
         return len(batch)
 
+    def window(self, devices: np.ndarray, w: int,
+               mtype: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Scoring-server entry: last-w window for one channel."""
+        return self.channel(mtype).window(devices, w)
+
     def snapshot(self, mtype: int = 0,
                  max_devices: Optional[int] = None) -> tuple[np.ndarray, np.ndarray]:
         """Training-dataset view: copies (values[D, T], count[D]) for a

@@ -1,0 +1,3 @@
+from sitewhere_tpu.scoring.server import ScoringSession, ScoringConfig
+
+__all__ = ["ScoringSession", "ScoringConfig"]

@@ -12,6 +12,7 @@ from sitewhere_tpu.services.event_management import EventManagementService
 from sitewhere_tpu.services.event_sources import EventSourcesService
 from sitewhere_tpu.services.inbound_processing import InboundProcessingService
 from sitewhere_tpu.services.device_state import DeviceStateService
+from sitewhere_tpu.services.rule_processing import RuleProcessingService
 
 __all__ = [
     "DeviceManagementService",
@@ -20,4 +21,5 @@ __all__ = [
     "EventSourcesService",
     "InboundProcessingService",
     "DeviceStateService",
+    "RuleProcessingService",
 ]

@@ -140,15 +140,21 @@ class QueueEventReceiver(BackgroundTaskComponent):
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
 
     async def submit(self, payload: bytes) -> None:
-        await self.queue.put(payload)
+        # ingest time is stamped at arrival so queue wait under load is
+        # part of measured end-to-end latency (no flattering p99s)
+        await self.queue.put((payload, time.monotonic()))
 
     def submit_nowait(self, payload: bytes) -> None:
-        self.queue.put_nowait(payload)
+        self.queue.put_nowait((payload, time.monotonic()))
 
     async def _run(self) -> None:
         while True:
-            payload = await self.queue.get()
-            await self.engine.process_payload(payload, self.name, self.decoder)
+            payload, t_in = await self.queue.get()
+            await self.engine.process_payload(payload, self.name, self.decoder,
+                                              ingest_monotonic=t_in)
+            # queue.get on a non-empty queue never suspends; yield so the
+            # rest of the pipeline runs while we drain a deep backlog
+            await asyncio.sleep(0)
 
 
 class TcpEventReceiver(BackgroundTaskComponent):
@@ -182,7 +188,8 @@ class TcpEventReceiver(BackgroundTaskComponent):
                                    " connection", self.name, length, self.max_frame)
                     break
                 payload = await reader.readexactly(length)
-                await self.engine.process_payload(payload, self.name, self.decoder)
+                await self.engine.process_payload(payload, self.name, self.decoder,
+                                                  ingest_monotonic=time.monotonic())
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
@@ -252,8 +259,11 @@ class EventSourcesEngine(TenantEngine):
         raise KeyError(name)
 
     async def process_payload(self, payload: bytes, source: str,
-                              decoder: EventDecoder) -> None:
+                              decoder: EventDecoder,
+                              ingest_monotonic: Optional[float] = None) -> None:
         ctx = BatchContext(tenant_id=self.tenant_id, source=source)
+        if ingest_monotonic is not None:
+            ctx.ingest_monotonic = ingest_monotonic
         try:
             batches = decoder.decode(payload, ctx)
         except Exception as exc:  # noqa: BLE001 - failed decode is data, not a crash

@@ -1,0 +1,192 @@
+"""rule-processing service (reference: service-rule-processing,
+[SURVEY.md §2.2]): stream processing over enriched events.
+
+The reference's extension points are Siddhi CEP queries and Groovy stream
+processors; the north star replaces them with XLA-compiled models at the
+same hook point [BASELINE.json north_star, SURVEY.md §1 L5]. This engine
+hosts both kinds of processor:
+
+- **model processor**: a `ScoringSession` (admission batching + bucketed
+  TPU inference). Anomalies become system DeviceAlerts via
+  event-management (the reference's rule actions emit events the same
+  way); every scored batch is also published to the scored-events topic.
+- **python hooks**: named async callables over enriched records — the
+  Groovy-script capability surface, with the same bindings style (the
+  hook receives the record plus an api handle object).
+
+Tenant config section `rule-processing`:
+  model: "zscore" | "lstm" | ... (registry name; null disables scoring)
+  model_config: {window: 64, hidden: 64, ...}
+  threshold: 4.0
+  batch_window_ms: 2.0
+  emit_alerts: true
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.batch import AlertBatch, MeasurementBatch, ScoredBatch
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+from sitewhere_tpu.models.registry import build_model
+from sitewhere_tpu.scoring.server import ScoringConfig, ScoringSession
+
+logger = logging.getLogger(__name__)
+
+Hook = Callable[[object, "RuleApi"], Awaitable[None]]
+
+
+@dataclass
+class RuleApi:
+    """Bindings handed to python hooks (reference: Groovy script bindings —
+    event + api handles, [SURVEY.md §2.1 script manager])."""
+
+    engine: "RuleProcessingEngine"
+
+    async def emit_alert(self, device_index: int, level: int, type: str,
+                         message: str) -> None:
+        em = self.engine.runtime.api("event-management").management(
+            self.engine.tenant_id)
+        batch = AlertBatch(
+            ctx=None, device_index=np.asarray([device_index], np.uint32),
+            level=np.asarray([level], np.uint8), type=[type],
+            message=[message], ts=np.asarray([time.time()]), source="rule")
+        em.add_alert_batch(batch)
+
+    def device_state(self, device_index: int) -> dict:
+        ds = self.engine.runtime.api("device-state").state(self.engine.tenant_id)
+        return ds.get_state(device_index)
+
+
+class RuleProcessingEngine(TenantEngine):
+    def __init__(self, service: "RuleProcessingService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        cfg = tenant.section("rule-processing", {"model": "zscore"})
+        self.model_name: Optional[str] = cfg.get("model", "zscore")
+        self.model_config: dict = cfg.get("model_config", {})
+        self.scoring_cfg = ScoringConfig(
+            mtype=cfg.get("mtype", 0),
+            threshold=cfg.get("threshold", 4.0),
+            batch_window_ms=cfg.get("batch_window_ms",
+                                    self.runtime.settings.scoring_batch_window_ms),
+            buckets=tuple(cfg.get("buckets",
+                                  self.runtime.settings.scoring_batch_buckets)),
+        )
+        self.emit_alerts: bool = cfg.get("emit_alerts", True)
+        self.session: Optional[ScoringSession] = None
+        self.hooks: dict[str, Hook] = {}
+        self.processor = RuleProcessor(self)
+        self.add_child(self.processor)
+
+    async def _do_initialize(self, monitor) -> None:
+        if self.model_name:
+            em = await self.runtime.wait_for_engine("event-management",
+                                                    self.tenant_id)
+            model = build_model(self.model_name, **self.model_config)
+            self.session = ScoringSession(
+                model, em.telemetry, self.runtime.metrics, self.scoring_cfg)
+
+    async def _do_start(self, monitor) -> None:
+        if self.session is not None:
+            # warm up in the background: engine start must not block on
+            # first-time TPU compiles (tens of seconds over a tunnel)
+            self.session.ready = False
+            self._warmup_task = asyncio.create_task(
+                self.session.warmup_async(), name=f"{self.path}/warmup")
+
+    async def _do_stop(self, monitor) -> None:
+        task = getattr(self, "_warmup_task", None)
+        if task is not None and not task.done():
+            task.cancel()
+        if self.session is not None:
+            self.session.close()
+
+    # -- extension points --------------------------------------------------
+
+    def add_hook(self, name: str, hook: Hook) -> None:
+        """Register a python stream hook (Groovy-processor analog)."""
+        self.hooks[name] = hook
+
+    def remove_hook(self, name: str) -> None:
+        self.hooks.pop(name, None)
+
+    def swap_model_params(self, params: dict) -> int:
+        """Hot-swap scoring params (called on checkpoint rollout)."""
+        if self.session is None:
+            raise RuntimeError("no model session configured")
+        return self.session.swap_params(params)
+
+
+class RuleProcessor(BackgroundTaskComponent):
+    def __init__(self, engine: RuleProcessingEngine):
+        super().__init__("rule-processor")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        session = engine.session
+        scored_topic = engine.tenant_topic(TopicNaming.SCORED_EVENTS)
+        consumer = runtime.bus.subscribe(
+            engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
+            group=f"{tenant_id}.rule-processing")
+        api = RuleApi(engine)
+        em = None
+        if engine.emit_alerts:
+            em = (await runtime.wait_for_engine("event-management", tenant_id))
+        try:
+            while True:
+                timeout = session.flush_wait_s if session else 0.2
+                records = await consumer.poll(max_records=64,
+                                              timeout=max(timeout, 0.001))
+                for record in records:
+                    value = record.value
+                    if session is not None and isinstance(value, MeasurementBatch):
+                        session.admit(value)
+                    for name, hook in engine.hooks.items():
+                        try:
+                            await hook(value, api)
+                        except Exception:  # noqa: BLE001 - hook errors isolated
+                            logger.exception("hook %s failed", name)
+                if session is not None and session.flush_due:
+                    scored = await session.flush()
+                    if scored is not None:
+                        await runtime.bus.produce(scored_topic, scored,
+                                                  key=scored.ctx.source)
+                        if em is not None and scored.is_anomaly.any():
+                            self._emit_anomaly_alerts(em, scored)
+                consumer.commit()
+        finally:
+            consumer.close()
+
+    def _emit_anomaly_alerts(self, em, scored: ScoredBatch) -> None:
+        """Anomalous events → system alerts (source='model')."""
+        idx = np.nonzero(scored.is_anomaly)[0]
+        batch = AlertBatch(
+            ctx=scored.ctx,
+            device_index=scored.device_index[idx],
+            level=np.full(idx.shape[0], 2, np.uint8),  # ERROR
+            type=[f"anomaly.{self.engine.model_name}"] * idx.shape[0],
+            message=[f"anomaly score {scored.score[i]:.2f} "
+                     f"(model v{scored.model_version})" for i in idx],
+            ts=scored.ts[idx],
+            source="model")
+        em.add_alert_batch(batch)
+
+
+class RuleProcessingService(Service):
+    identifier = "rule-processing"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> RuleProcessingEngine:
+        return RuleProcessingEngine(self, tenant)

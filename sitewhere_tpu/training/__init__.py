@@ -1,0 +1,3 @@
+from sitewhere_tpu.training.trainer import Trainer, TrainerConfig, make_windows
+
+__all__ = ["Trainer", "TrainerConfig", "make_windows"]

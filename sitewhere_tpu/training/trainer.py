@@ -1,0 +1,129 @@
+"""Data-parallel trainer over the columnar event store.
+
+The training plane of the north star [BASELINE.json]: batch-operations
+triggers training jobs over historical telemetry; gradients allreduce
+over ICI via pjit sharding (the reference has no training plane —
+[SURVEY.md §2.4]).
+
+Design:
+- dataset = sliding windows cut from the TelemetryStore ring (`[D, T]` →
+  `[N, W]` via one strided gather; no ETL).
+- the train step is jit'd once with shardings: params replicated, batch
+  sharded over the `data` mesh axis → XLA inserts the gradient psum.
+- runs identically on 1 chip, a v5e-8, or the CPU host-platform mesh
+  (tests / driver dryrun).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from sitewhere_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+
+
+@dataclass(frozen=True)
+class TrainerConfig:
+    learning_rate: float = 1e-3
+    batch_size: int = 1024
+    steps: int = 200
+    seed: int = 0
+    log_every: int = 50
+
+
+def make_windows(values: np.ndarray, counts: np.ndarray, window: int,
+                 stride: int = 1, max_windows: Optional[int] = None,
+                 seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Cut training windows from a store snapshot.
+
+    values: [D, T] chronological per device; counts: [D] valid suffix
+    lengths (ring semantics: the valid data is the LAST `counts[d]`
+    entries). Returns (windows [N, W], valid [N, W]).
+    """
+    d_count, t = values.shape
+    starts = []
+    for d in range(d_count):
+        c = int(min(counts[d], t))
+        if c < window:
+            continue
+        lo = t - c
+        for s in range(lo, t - window + 1, stride):
+            starts.append((d, s))
+    if not starts:
+        return (np.zeros((0, window), np.float32),
+                np.zeros((0, window), bool))
+    starts_arr = np.asarray(starts)
+    if max_windows is not None and len(starts_arr) > max_windows:
+        rng = np.random.default_rng(seed)
+        starts_arr = starts_arr[rng.choice(len(starts_arr), max_windows,
+                                           replace=False)]
+    idx = starts_arr[:, 1][:, None] + np.arange(window)[None, :]
+    windows = values[starts_arr[:, 0][:, None], idx]
+    return windows.astype(np.float32), np.ones_like(windows, dtype=bool)
+
+
+class Trainer:
+    """Self-supervised trainer for any registry model."""
+
+    def __init__(self, model, cfg: TrainerConfig = TrainerConfig(),
+                 mesh: Optional[Mesh] = None):
+        self.model = model
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(model=1)
+        self.opt = optax.adam(cfg.learning_rate)
+        self._step_fn = None
+
+    def _build_step(self):
+        model, opt = self.model, self.opt
+
+        def step(params, opt_state, x, valid):
+            loss, grads = jax.value_and_grad(model.loss)(params, x, valid)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        rep = replicated(self.mesh)
+        bs = batch_sharding(self.mesh, 2)
+        return jax.jit(step,
+                       in_shardings=(rep, rep, bs, bs),
+                       out_shardings=(rep, rep, rep))
+
+    def train(self, windows: np.ndarray, valid: np.ndarray,
+              params: Optional[dict] = None) -> tuple[dict, dict]:
+        """Train over the window dataset; returns (params, report)."""
+        cfg = self.cfg
+        if params is None:
+            params = self.model.init(jax.random.PRNGKey(cfg.seed))
+        rep = replicated(self.mesh)
+        params = jax.device_put(params, rep)
+        opt_state = jax.device_put(self.opt.init(params), rep)
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+
+        n = windows.shape[0]
+        if n == 0:
+            return params, {"steps": 0, "losses": [], "seconds": 0.0}
+        d = self.mesh.shape["data"]
+        bs = max((cfg.batch_size // d) * d, d)  # divisible by data axis
+        rng = np.random.default_rng(cfg.seed)
+        bsh = batch_sharding(self.mesh, 2)
+
+        losses = []
+        t0 = time.monotonic()
+        for step_i in range(cfg.steps):
+            idx = rng.integers(0, n, bs)
+            xb = jax.device_put(windows[idx], bsh)
+            vb = jax.device_put(valid[idx], bsh)
+            params, opt_state, loss = self._step_fn(params, opt_state, xb, vb)
+            if step_i % cfg.log_every == 0 or step_i == cfg.steps - 1:
+                losses.append(float(loss))
+        elapsed = time.monotonic() - t0
+        return params, {"steps": cfg.steps, "losses": losses,
+                        "seconds": elapsed,
+                        "final_loss": losses[-1] if losses else None}

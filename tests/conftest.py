@@ -7,14 +7,22 @@ multi-device semantics without TPU hardware. Must run before jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the image pins JAX_PLATFORMS=axon (the tunneled TPU) and
+# re-asserts it at interpreter startup, so setdefault is not enough and the
+# jax.config update below is what actually sticks.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import asyncio  # noqa: E402
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"test mesh wants 8 virtual CPU devices, got {jax.devices()}")
 
 
 @pytest.fixture

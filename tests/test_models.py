@@ -1,0 +1,107 @@
+"""Model zoo tests: contract compliance, golden behaviors, trainability
+[SURVEY.md §4: golden-number tests for model kernels]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sitewhere_tpu.models import build_model
+from sitewhere_tpu.models.lstm import LstmAnomalyModel, LstmConfig
+from sitewhere_tpu.models.zscore import ZScoreModel, ZScoreConfig
+
+
+def synthetic_windows(b=32, w=64, seed=0, anomaly_rows=()):
+    """Smooth sinusoid windows; selected rows get a spike at the end."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(w)
+    phase = rng.uniform(0, 2 * np.pi, (b, 1))
+    x = 20 + 2 * np.sin(2 * np.pi * t / 32 + phase) \
+        + 0.1 * rng.standard_normal((b, w))
+    for r in anomaly_rows:
+        x[r, -1] += 10.0
+    return x.astype(np.float32), np.ones((b, w), bool)
+
+
+def test_zscore_flags_spikes_not_normals():
+    model = ZScoreModel(ZScoreConfig(window=64))
+    x, valid = synthetic_windows(anomaly_rows=(3, 17))
+    scores = np.asarray(model.score({}, jnp.asarray(x), jnp.asarray(valid)))
+    assert scores[3] > 4.0 and scores[17] > 4.0
+    normal = np.delete(scores, [3, 17])
+    assert normal.max() < 3.0
+
+
+def test_zscore_insufficient_history_scores_zero():
+    model = ZScoreModel(ZScoreConfig(window=64, min_history=8))
+    x, valid = synthetic_windows(b=4)
+    valid[:2, :-4] = False  # only 4 valid points
+    scores = np.asarray(model.score({}, jnp.asarray(x), jnp.asarray(valid)))
+    assert (scores[:2] == 0).all()
+    assert (scores[2:] >= 0).all()
+
+
+def test_lstm_shapes_and_jit():
+    model = LstmAnomalyModel(LstmConfig(window=32, hidden=16))
+    params = model.init(jax.random.PRNGKey(0))
+    x, valid = synthetic_windows(b=8, w=32)
+    scores = jax.jit(model.score)(params, jnp.asarray(x), jnp.asarray(valid))
+    assert scores.shape == (8,)
+    assert bool(jnp.isfinite(scores).all())
+    loss = jax.jit(model.loss)(params, jnp.asarray(x), jnp.asarray(valid))
+    assert loss.shape == () and bool(jnp.isfinite(loss))
+
+
+def test_lstm_training_reduces_loss_and_separates_anomalies():
+    import optax
+
+    model = LstmAnomalyModel(LstmConfig(window=32, hidden=32))
+    params = model.init(jax.random.PRNGKey(1))
+    x, valid = synthetic_windows(b=64, w=32, seed=2)
+    xj, vj = jnp.asarray(x), jnp.asarray(valid)
+
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(model.loss)(params, xj, vj)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    losses = []
+    for _ in range(60):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, f"no learning: {losses[0]} -> {losses[-1]}"
+
+    # after training, spiked rows separate from clean rows
+    xa, va = synthetic_windows(b=16, w=32, seed=3, anomaly_rows=(5,))
+    scores = np.asarray(model.score(params, jnp.asarray(xa), jnp.asarray(va)))
+    clean = np.delete(scores, 5)
+    assert scores[5] > clean.max() * 2
+
+
+def test_lstm_vmap_over_stacked_tenant_params():
+    """Per-tenant multiplexing: vmap over a leading tenant axis of params
+    (config 4 groundwork [SURVEY.md §2.4 per-tenant sharding])."""
+    model = LstmAnomalyModel(LstmConfig(window=16, hidden=8))
+    p0 = model.init(jax.random.PRNGKey(0))
+    p1 = model.init(jax.random.PRNGKey(1))
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
+    x, valid = synthetic_windows(b=4, w=16)
+    xs = jnp.stack([jnp.asarray(x)] * 2)
+    vs = jnp.stack([jnp.asarray(valid)] * 2)
+    scores = jax.vmap(model.score)(stacked, xs, vs)
+    assert scores.shape == (2, 4)
+    # different params → different scores, same per-tenant contract
+    assert not np.allclose(np.asarray(scores[0]), np.asarray(scores[1]))
+
+
+def test_registry_builds_and_rejects():
+    m = build_model("zscore", window=32)
+    assert isinstance(m, ZScoreModel) and m.cfg.window == 32
+    m = build_model("lstm", hidden=8)
+    assert isinstance(m, LstmAnomalyModel) and m.cfg.hidden == 8
+    with pytest.raises(ValueError):
+        build_model("nope")

@@ -24,16 +24,20 @@ from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
 
 
 @contextlib.asynccontextmanager
-async def running_pipeline(num_devices: int = 100):
+async def running_pipeline(num_devices: int = 100, sections: dict | None = None):
     """Started runtime with tenant 'acme' and a registered fleet."""
+    from sitewhere_tpu.services import RuleProcessingService
+
     rt = ServiceRuntime(InstanceSettings(instance_id="e2e"))
     rt.add_service(DeviceManagementService(rt))
     rt.add_service(EventSourcesService(rt))
     rt.add_service(InboundProcessingService(rt))
     rt.add_service(EventManagementService(rt))
     rt.add_service(DeviceStateService(rt))
+    if sections and "rule-processing" in sections:
+        rt.add_service(RuleProcessingService(rt))
     await rt.start()
-    await rt.add_tenant(TenantConfig(tenant_id="acme"))
+    await rt.add_tenant(TenantConfig(tenant_id="acme", sections=sections or {}))
     dm = rt.api("device-management").management("acme")
     dt = DeviceType(token="thermo", name="Thermometer", channels=("temp",))
     dm.bootstrap_fleet(dt, num_devices)

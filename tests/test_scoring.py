@@ -1,0 +1,171 @@
+"""Scoring server + rule-processing hook tests (config 2 [BASELINE.json]):
+simulator → pipeline → XLA-scored anomaly alerts [SURVEY.md §7 step 3]."""
+
+import asyncio
+
+import numpy as np
+
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+from sitewhere_tpu.models import build_model
+from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.scoring.server import ScoringConfig, ScoringSession
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+from tests.test_pipeline import running_pipeline, wait_until
+
+
+def _fill_store(store: TelemetryStore, sim: DeviceSimulator, ticks: int,
+                t0: float = 0.0):
+    for k in range(ticks):
+        batch, _ = sim.tick(t=t0 + 60.0 * k)
+        store.append_measurements(batch)
+
+
+def test_scoring_session_detects_injected_anomalies(run):
+    async def main():
+        store = TelemetryStore(history=128)
+        sim = DeviceSimulator(SimConfig(num_devices=200, seed=3), tenant_id="t")
+        _fill_store(store, sim, 70)  # warm history, no anomalies
+
+        session = ScoringSession(
+            build_model("zscore", window=64), store, MetricsRegistry(),
+            ScoringConfig(buckets=(256,), threshold=4.0))
+        session.warmup()
+
+        # final tick with injected anomalies lands in the store
+        sim.cfg = SimConfig(num_devices=200, seed=3, anomaly_rate=0.05,
+                            anomaly_magnitude=12.0)
+        batch, truth = sim.tick(t=70 * 60.0)
+        store.append_measurements(batch)
+
+        scored = await session.score_devices(
+            batch.device_index, batch.ts,
+            np.zeros(len(batch)), batch.ctx)
+        detected = scored.is_anomaly
+        # perfect separation for 12-sigma-ish spikes vs zscore rule
+        assert (detected == truth).mean() > 0.97
+        assert detected[truth].mean() > 0.9
+
+    run(main())
+
+
+def test_scoring_bucket_padding_and_chunking(run):
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=600), tenant_id="t")
+        _fill_store(store, sim, 40)
+        session = ScoringSession(
+            build_model("zscore", window=32), store, MetricsRegistry(),
+            ScoringConfig(buckets=(64, 256), threshold=4.0))
+        # 600 devices with max bucket 256 → chunks of 256/256/88→pad 256
+        devices = np.arange(600, dtype=np.uint32)
+        scored = await session.score_devices(
+            devices, np.zeros(600), np.zeros(600),
+            BatchContext(tenant_id="t"))
+        assert len(scored) == 600
+        assert np.isfinite(scored.score).all()
+
+    run(main())
+
+
+def test_admission_batching_deadline(run):
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=10), tenant_id="t")
+        _fill_store(store, sim, 40)
+        session = ScoringSession(
+            build_model("zscore", window=32), store, MetricsRegistry(),
+            ScoringConfig(buckets=(64,), batch_window_ms=5.0))
+        batch, _ = sim.tick(t=41 * 60.0)
+        assert not session.flush_due
+        session.admit(batch)
+        assert not session.flush_due  # deadline not reached
+        await asyncio.sleep(0.006)
+        assert session.flush_due
+        scored = await session.flush()
+        assert len(scored) == 10
+        assert session.flush_due is False and await session.flush() is None
+
+    run(main())
+
+
+def test_e2e_scoring_alerts_in_pipeline(run):
+    """Full config-2 slice: ingest → persist → score → model alerts."""
+
+    async def main():
+        sections = {"rule-processing": {"model": "zscore",
+                                        "model_config": {"window": 32},
+                                        "threshold": 5.0,
+                                        "batch_window_ms": 1.0}}
+        async with running_pipeline(num_devices=100,
+                                    sections=sections) as rt:
+            sim = DeviceSimulator(SimConfig(num_devices=100, seed=11),
+                                  tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme").receiver("default")
+            # history: clean
+            for k in range(40):
+                payload, _ = sim.payload(t=60.0 * k)
+                await receiver.submit(payload)
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 4000)
+
+            # anomaly tick
+            sim.cfg = SimConfig(num_devices=100, seed=11, anomaly_rate=0.1,
+                                anomaly_magnitude=15.0)
+            payload, truth = sim.payload(t=41 * 60.0)
+            await receiver.submit(payload)
+
+            n_true = int(truth.sum())
+            assert n_true > 0
+            await wait_until(lambda: len(em.list_alerts()) >= n_true,
+                             timeout=15.0)
+            alerts = em.list_alerts()
+            assert all(a.source == "model" for a in alerts)
+            assert all(a.type == "anomaly.zscore" for a in alerts)
+            # alerts point at the truly anomalous devices
+            dm = rt.api("device-management").management("acme")
+            alert_devices = {dm.get_device(a.device_id).index for a in alerts}
+            true_devices = set(np.nonzero(truth)[0].tolist())
+            assert alert_devices == true_devices
+
+            # scored batches were published for observability
+            scored_topic = rt.naming.tenant_topic("acme", "scored-events")
+            assert sum(rt.bus.end_offsets(scored_topic)) > 0
+
+            snap = rt.metrics.snapshot()
+            assert snap["scoring.events_scored"]["rate_60s"] > 0
+            assert snap["scoring.e2e_latency_s"]["count"] >= 4100
+
+    run(main())
+
+
+def test_python_hook_receives_batches(run):
+    """The Groovy-stream-processor capability: python hooks over enriched
+    records with api bindings."""
+
+    async def main():
+        # model: None → hooks only, no scoring session
+        async with running_pipeline(
+                num_devices=10,
+                sections={"rule-processing": {"model": None}}) as rt:
+            engine = rt.api("rule-processing").engine("acme")
+            seen = []
+
+            async def hook(value, api):
+                if isinstance(value, MeasurementBatch):
+                    seen.append(len(value))
+                    if len(seen) == 1:
+                        await api.emit_alert(3, 1, "custom", "hook fired")
+
+            engine.add_hook("test-hook", hook)
+            sim = DeviceSimulator(SimConfig(num_devices=10), tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme").receiver("default")
+            await receiver.submit(sim.payload(t=100.0)[0])
+
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: sum(seen) >= 10)
+            await wait_until(
+                lambda: any(a.type == "custom" for a in em.list_alerts()))
+
+    run(main())
