@@ -169,6 +169,13 @@ class AlertBatch:
     def __len__(self) -> int:
         return int(self.device_index.shape[0])
 
+    def select(self, mask: np.ndarray) -> "AlertBatch":
+        idx = np.nonzero(mask)[0]
+        return AlertBatch(
+            self.ctx, self.device_index[idx], self.level[idx],
+            [self.type[i] for i in idx], [self.message[i] for i in idx],
+            self.ts[idx] if self.ts is not None else None, self.source)
+
 
 @dataclass(slots=True)
 class RegistrationBatch:
@@ -200,3 +207,8 @@ class ScoredBatch:
 
     def __len__(self) -> int:
         return int(self.device_index.shape[0])
+
+    def select(self, mask: np.ndarray) -> "ScoredBatch":
+        return ScoredBatch(self.ctx, self.device_index[mask],
+                           self.score[mask], self.is_anomaly[mask],
+                           self.ts[mask], self.model_version)

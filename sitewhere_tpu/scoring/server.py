@@ -209,9 +209,13 @@ class ScoringSession:
 
     @property
     def flush_wait_s(self) -> float:
-        """How long poll may wait before the admission deadline."""
+        """How long poll may wait before the admission deadline.
+
+        Idle (or still warming up) → a long timeout: poll wakes on new
+        records anyway, so this costs no latency but stops the processor
+        busy-looping at the window period."""
         if self._pending_n == 0 or not self.ready:
-            return self.cfg.batch_window_ms / 1e3
+            return 0.2
         return max((self._deadline or 0.0) - time.monotonic(), 0.0)
 
     async def flush(self) -> Optional[ScoredBatch]:
@@ -222,8 +226,13 @@ class ScoringSession:
         dev = np.concatenate([p[0] for p in pending])
         ts = np.concatenate([p[1] for p in pending])
         ingest = np.concatenate([p[2] for p in pending])
+        # merged context: keep the earliest ingest stamp; name all sources
+        sources = {p[3].source for p in pending}
+        ctx = pending[0][3] if len(sources) == 1 else BatchContext(
+            tenant_id=pending[0][3].tenant_id, source="+".join(sorted(sources)),
+            ingest_monotonic=min(p[3].ingest_monotonic for p in pending))
         t0 = time.monotonic()
-        scored = await self.score_devices(dev, ts, ingest, pending[0][3])
+        scored = await self.score_devices(dev, ts, ingest, ctx)
         self.batch_latency.observe(time.monotonic() - t0)
         return scored
 

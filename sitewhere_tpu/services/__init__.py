@@ -13,8 +13,14 @@ from sitewhere_tpu.services.event_sources import EventSourcesService
 from sitewhere_tpu.services.inbound_processing import InboundProcessingService
 from sitewhere_tpu.services.device_state import DeviceStateService
 from sitewhere_tpu.services.rule_processing import RuleProcessingService
+from sitewhere_tpu.services.device_registration import DeviceRegistrationService
+from sitewhere_tpu.services.command_delivery import CommandDeliveryService
+from sitewhere_tpu.services.outbound_connectors import OutboundConnectorsService
+from sitewhere_tpu.services.batch_operations import BatchOperationsService
+from sitewhere_tpu.services.schedule_management import ScheduleManagementService
+from sitewhere_tpu.services.label_generation import LabelGenerationService
 
-__all__ = [
+ALL_SERVICES = [
     "DeviceManagementService",
     "AssetManagementService",
     "EventManagementService",
@@ -22,4 +28,12 @@ __all__ = [
     "InboundProcessingService",
     "DeviceStateService",
     "RuleProcessingService",
+    "DeviceRegistrationService",
+    "CommandDeliveryService",
+    "OutboundConnectorsService",
+    "BatchOperationsService",
+    "ScheduleManagementService",
+    "LabelGenerationService",
 ]
+
+__all__ = list(ALL_SERVICES)

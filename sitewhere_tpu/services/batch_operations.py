@@ -1,0 +1,296 @@
+"""batch-operations service (reference: service-batch-operations,
+[SURVEY.md §2.2, §3.4]): long-running operations over device lists —
+chunked elements through the bus, progress tracking, throttling — plus
+the north star's training trigger [BASELINE.json]: a batch operation
+whose processor is a pjit training job over the event store.
+
+Operation types:
+- `command-invocation` (reference parity): invoke a command on every
+  device in the list; elements chunked onto the batch-elements topic and
+  processed with optional throttling.
+- `train-model` (north star): snapshot the tenant's telemetry, cut
+  windows, train under the (data, model) mesh, checkpoint via Orbax,
+  hot-swap the scoring session's params, record the loss curve in the
+  operation result.
+
+API: `submit_command_operation(...)`, `submit_training_operation(...)`,
+`get_operation(id)`, `list_operations()`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Optional, Sequence
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.events import DeviceCommandInvocation
+from sitewhere_tpu.domain.model import (
+    BatchElement,
+    BatchElementStatus,
+    BatchOperation,
+    BatchOperationStatus,
+)
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+from sitewhere_tpu.persistence.memory import InMemoryBatchManagement
+
+logger = logging.getLogger(__name__)
+
+
+class BatchOperationsEngine(TenantEngine):
+    def __init__(self, service: "BatchOperationsService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        cfg = tenant.section("batch-operations", {})
+        self.spi = InMemoryBatchManagement()
+        self.chunk_size = cfg.get("chunk_size", 100)
+        self.throttle_ms = cfg.get("throttle_ms", 0.0)
+        self.checkpoint_root = cfg.get("checkpoint_root", ".checkpoints")
+        self.processor = BatchElementProcessor(self)
+        self.add_child(self.processor)
+
+    # -- submission API (reference: BatchOperationManager) -----------------
+
+    async def submit_command_operation(
+            self, device_ids: Sequence[str], command_id: str,
+            parameters: Optional[dict] = None,
+            initiator: str = "rest", initiator_id: str = "") -> BatchOperation:
+        op = BatchOperation(
+            operation_type="command-invocation",
+            parameters={"command_id": command_id,
+                        "parameter_values": parameters or {},
+                        "initiator": initiator, "initiator_id": initiator_id},
+            processing_status=BatchOperationStatus.INITIALIZING)
+        self.spi.create_batch_operation(op)
+        elements = [BatchElement(batch_operation_id=op.id, device_id=d)
+                    for d in device_ids]
+        self.spi.create_batch_elements(elements)
+        if not elements:  # empty list: nothing to do, don't hang PROCESSING
+            return self._set_status(op.id,
+                                    BatchOperationStatus.FINISHED_SUCCESSFULLY,
+                                    started=True, ended=True)
+        # chunk element ids onto the bus (reference §3.4: chunked via Kafka)
+        topic = self.tenant_topic(TopicNaming.BATCH_ELEMENTS)
+        for lo in range(0, len(elements), self.chunk_size):
+            chunk = [e.id for e in elements[lo:lo + self.chunk_size]]
+            await self.runtime.bus.produce(
+                topic, {"operation_id": op.id, "element_ids": chunk},
+                key=op.id)
+        return self._set_status(op.id, BatchOperationStatus.PROCESSING,
+                                started=True)
+
+    async def submit_training_operation(
+            self, model_name: Optional[str] = None, *,
+            steps: int = 200, batch_size: int = 1024,
+            learning_rate: float = 1e-3, window: Optional[int] = None,
+            max_windows: int = 200_000, mtype: int = 0) -> BatchOperation:
+        op = BatchOperation(
+            operation_type="train-model",
+            parameters={"model": model_name, "steps": steps,
+                        "batch_size": batch_size, "lr": learning_rate,
+                        "window": window, "max_windows": max_windows,
+                        "mtype": mtype},
+            processing_status=BatchOperationStatus.INITIALIZING)
+        self.spi.create_batch_operation(op)
+        await self.runtime.bus.produce(
+            self.tenant_topic(TopicNaming.BATCH_ELEMENTS),
+            {"operation_id": op.id, "train": True}, key=op.id)
+        return self._set_status(op.id, BatchOperationStatus.PROCESSING,
+                                started=True)
+
+    def _set_status(self, op_id: str, status: BatchOperationStatus,
+                    started: bool = False, ended: bool = False,
+                    result: Optional[dict] = None) -> BatchOperation:
+        op = self.spi.get_batch_operation(op_id)
+        changes: dict = {"processing_status": status}
+        if started:
+            changes["processing_started_date"] = time.time()
+        if ended:
+            changes["processing_ended_date"] = time.time()
+        if result is not None:
+            changes["parameters"] = {**op.parameters, "result": result}
+        return self.spi.update_batch_operation(
+            dataclasses.replace(op, **changes))
+
+    def get_operation(self, op_id: str) -> Optional[BatchOperation]:
+        return self.spi.get_batch_operation(op_id)
+
+    async def wait_for_operation(self, op_id: str,
+                                 timeout: float = 60.0) -> BatchOperation:
+        deadline = time.monotonic() + timeout
+        terminal = (BatchOperationStatus.FINISHED_SUCCESSFULLY,
+                    BatchOperationStatus.FINISHED_WITH_ERRORS)
+        while True:
+            op = self.spi.get_batch_operation(op_id)
+            if op is not None and op.processing_status in terminal:
+                return op
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"operation {op_id} not finished")
+            await asyncio.sleep(0.05)
+
+    def __getattr__(self, name):
+        return getattr(self.spi, name)
+
+
+class BatchElementProcessor(BackgroundTaskComponent):
+    """(reference: BatchElementProcessor) consumes element chunks."""
+
+    def __init__(self, engine: BatchOperationsEngine):
+        super().__init__("batch-element-processor")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        consumer = runtime.bus.subscribe(
+            engine.tenant_topic(TopicNaming.BATCH_ELEMENTS),
+            group=f"{tenant_id}.batch-operations")
+        processed = runtime.metrics.counter("batch.elements_processed")
+        try:
+            while True:
+                for record in await consumer.poll(max_records=16, timeout=0.5):
+                    chunk = record.value
+                    try:
+                        if chunk.get("train"):
+                            await self._run_training(chunk["operation_id"])
+                        else:
+                            n = await self._process_command_chunk(chunk)
+                            processed.inc(n)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("batch chunk failed")
+                        engine._set_status(
+                            chunk["operation_id"],
+                            BatchOperationStatus.FINISHED_WITH_ERRORS,
+                            ended=True)
+                consumer.commit()
+        finally:
+            consumer.close()
+
+    # -- command invocation elements ---------------------------------------
+
+    async def _process_command_chunk(self, chunk: dict) -> int:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        op = engine.spi.get_batch_operation(chunk["operation_id"])
+        if op is None:
+            return 0
+        em = await runtime.wait_for_engine("event-management", tenant_id)
+        dm = await runtime.wait_for_engine("device-management", tenant_id)
+        elements = {e.id: e for e in
+                    engine.spi.list_batch_elements(op.id)}
+        count = 0
+        for el_id in chunk["element_ids"]:
+            el = elements.get(el_id)
+            if el is None or el.processing_status != BatchElementStatus.UNPROCESSED:
+                continue  # idempotent under at-least-once redelivery
+            device = dm.get_device(el.device_id)
+            ok = device is not None
+            if ok:
+                assignments = dm.get_active_assignments_for_device(device.id)
+                inv = DeviceCommandInvocation(
+                    device_id=device.id,
+                    assignment_id=assignments[0].id if assignments else "",
+                    initiator=op.parameters.get("initiator", "batch"),
+                    initiator_id=op.id,
+                    command_id=op.parameters["command_id"],
+                    parameter_values=op.parameters.get("parameter_values", {}))
+                await em.add_command_invocations([inv])
+            engine.spi.update_batch_element(dataclasses.replace(
+                el,
+                processing_status=(BatchElementStatus.SUCCEEDED if ok
+                                   else BatchElementStatus.FAILED),
+                processed_date=time.time()))
+            count += 1
+            if engine.throttle_ms:
+                await asyncio.sleep(engine.throttle_ms / 1e3)
+        self._maybe_finish(op.id)
+        return count
+
+    def _maybe_finish(self, op_id: str) -> None:
+        engine = self.engine
+        elements = engine.spi.list_batch_elements(op_id)
+        if any(e.processing_status in (BatchElementStatus.UNPROCESSED,
+                                       BatchElementStatus.PROCESSING)
+               for e in elements):
+            return
+        failed = any(e.processing_status == BatchElementStatus.FAILED
+                     for e in elements)
+        engine._set_status(
+            op_id,
+            BatchOperationStatus.FINISHED_WITH_ERRORS if failed
+            else BatchOperationStatus.FINISHED_SUCCESSFULLY,
+            ended=True)
+
+    # -- training operations (north star) ----------------------------------
+
+    async def _run_training(self, op_id: str) -> None:
+        from sitewhere_tpu.models.registry import build_model
+        from sitewhere_tpu.training.checkpoint import CheckpointStore
+        from sitewhere_tpu.training.trainer import Trainer, TrainerConfig, make_windows
+
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        op = engine.spi.get_batch_operation(op_id)
+        p = op.parameters
+
+        em = await runtime.wait_for_engine("event-management", tenant_id)
+        rule_service = runtime.services.get("rule-processing")
+        rule_engine = rule_service.engines.get(tenant_id) if rule_service else None
+
+        model_name = p.get("model") or (rule_engine.model_name if rule_engine
+                                        else "lstm")
+        model_cfg = dict(rule_engine.model_config) if rule_engine and \
+            rule_engine.model_name == model_name else {}
+        if p.get("window"):
+            model_cfg["window"] = p["window"]
+        model = build_model(model_name, **model_cfg)
+
+        # dataset: snapshot the columnar store (zero ETL [SURVEY.md §7])
+        values, counts = em.telemetry.snapshot(mtype=p.get("mtype", 0))
+        windows, valid = make_windows(values, counts, model.cfg.window,
+                                      stride=max(1, model.cfg.window // 4),
+                                      max_windows=p.get("max_windows"))
+        if windows.shape[0] == 0:
+            engine._set_status(op_id, BatchOperationStatus.FINISHED_WITH_ERRORS,
+                               ended=True,
+                               result={"error": "no training windows"})
+            return
+
+        trainer = Trainer(model, TrainerConfig(
+            learning_rate=p.get("lr", 1e-3), batch_size=p.get("batch_size", 1024),
+            steps=p.get("steps", 200)))
+        t0 = time.monotonic()
+        params, report = trainer.train(windows, valid)
+        report["windows"] = int(windows.shape[0])
+        report["train_seconds"] = round(time.monotonic() - t0, 3)
+
+        # checkpoint + hot-swap (reference §5.4 analog + north star rollout)
+        store = CheckpointStore(engine.checkpoint_root)
+        version = store.save(tenant_id, model_name,
+                             params, metadata={"report": {
+                                 k: v for k, v in report.items()
+                                 if k != "losses"}})
+        report["checkpoint_version"] = version
+        if rule_engine is not None and rule_engine.session is not None \
+                and rule_engine.model_name == model_name:
+            rule_engine.swap_model_params(params)
+            report["hot_swapped"] = True
+        engine._set_status(op_id, BatchOperationStatus.FINISHED_SUCCESSFULLY,
+                           ended=True, result=report)
+
+
+class BatchOperationsService(Service):
+    identifier = "batch-operations"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> BatchOperationsEngine:
+        return BatchOperationsEngine(self, tenant)
+
+    def operations(self, tenant_id: str) -> BatchOperationsEngine:
+        return self.engine(tenant_id)  # type: ignore[return-value]

@@ -1,0 +1,202 @@
+"""command-delivery service (reference: service-command-delivery,
+[SURVEY.md §2.2, §3.3]): route persisted command invocations to devices —
+encode (JSON / SWB1-binary) and deliver (in-proc queue, TCP push, or a
+registered custom provider; the reference's MQTT/CoAP/SMS providers map
+to the same `DeliveryProvider` protocol).
+
+Flow (reference §3.3): event-management persists a DeviceCommandInvocation
+and republishes it on the enriched topic; this service consumes it,
+resolves the target device + command, encodes, routes, delivers, and
+emits an `undelivered` record on failure.
+
+Tenant config section `command-delivery`:
+  encoder: "json" | "swb1"
+  provider: "queue" | "tcp" | <registered name>
+  routes: {"<device_type_token>": {"encoder": ..., "provider": ...}}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import time
+from typing import Optional, Protocol
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.events import DeviceCommandInvocation
+from sitewhere_tpu.domain.model import Device, DeviceCommand
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+
+logger = logging.getLogger(__name__)
+
+
+class CommandEncoder(Protocol):
+    """(reference: ICommandExecutionEncoder)"""
+
+    def encode(self, device: Device, command: Optional[DeviceCommand],
+               invocation: DeviceCommandInvocation) -> bytes: ...
+
+
+class JsonCommandEncoder:
+    def encode(self, device, command, invocation) -> bytes:
+        return json.dumps({
+            "device": device.token,
+            "command": command.name if command else invocation.command_id,
+            "namespace": command.namespace if command else "",
+            "parameters": invocation.parameter_values,
+            "invocation_id": invocation.id,
+            "initiator": invocation.initiator,
+        }).encode()
+
+
+class Swb1CommandEncoder:
+    """Compact binary framing for constrained devices (the reference's
+    protobuf agent-protocol encoder analog): magic 'SWC1' | u32 device
+    index | u16 name len | name | u32 json-params len | params."""
+
+    def encode(self, device, command, invocation) -> bytes:
+        name = (command.name if command else invocation.command_id).encode()
+        params = json.dumps(invocation.parameter_values).encode()
+        return (b"SWC1" + struct.pack("<IH", device.index, len(name)) + name
+                + struct.pack("<I", len(params)) + params)
+
+
+class DeliveryProvider(Protocol):
+    """(reference: ICommandDeliveryProvider)"""
+
+    async def deliver(self, device: Device, payload: bytes) -> bool: ...
+
+
+class QueueDeliveryProvider:
+    """In-proc delivery log/queue: the default provider, the test double,
+    and the device simulator's command inbox."""
+
+    def __init__(self) -> None:
+        self.delivered: list[tuple[str, bytes, float]] = []
+
+    async def deliver(self, device: Device, payload: bytes) -> bool:
+        self.delivered.append((device.token, payload, time.time()))
+        return True
+
+    def inbox(self, device_token: str) -> list[bytes]:
+        return [p for t, p, _ in self.delivered if t == device_token]
+
+
+class TcpPushDeliveryProvider:
+    """Push commands to a per-device TCP endpoint recorded in device
+    metadata (`push_host`/`push_port`) — length-prefixed frames."""
+
+    async def deliver(self, device: Device, payload: bytes) -> bool:
+        import asyncio
+
+        host = device.metadata.get("push_host")
+        port = device.metadata.get("push_port")
+        if not host or not port:
+            return False
+        try:
+            _, writer = await asyncio.open_connection(host, int(port))
+            writer.write(len(payload).to_bytes(4, "little") + payload)
+            await writer.drain()
+            writer.close()
+            return True
+        except OSError as exc:
+            logger.warning("tcp delivery to %s failed: %s", device.token, exc)
+            return False
+
+
+class CommandDeliveryEngine(TenantEngine):
+    def __init__(self, service: "CommandDeliveryService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        cfg = tenant.section("command-delivery", {})
+        self.encoders: dict[str, CommandEncoder] = {
+            "json": JsonCommandEncoder(), "swb1": Swb1CommandEncoder()}
+        self.providers: dict[str, DeliveryProvider] = {
+            "queue": QueueDeliveryProvider(), "tcp": TcpPushDeliveryProvider()}
+        self.default_encoder = cfg.get("encoder", "json")
+        self.default_provider = cfg.get("provider", "queue")
+        self.routes: dict[str, dict] = cfg.get("routes", {})
+        self.manager = CommandDeliveryManager(self)
+        self.add_child(self.manager)
+
+    def register_provider(self, name: str, provider: DeliveryProvider) -> None:
+        """Extension point for MQTT/CoAP/SMS-style providers."""
+        self.providers[name] = provider
+
+    def register_encoder(self, name: str, encoder: CommandEncoder) -> None:
+        self.encoders[name] = encoder
+
+    def route(self, device_type_token: str) -> tuple[CommandEncoder, DeliveryProvider]:
+        """(reference: ICommandRouter) resolve encoder+provider for a type."""
+        r = self.routes.get(device_type_token, {})
+        enc = self.encoders[r.get("encoder", self.default_encoder)]
+        prov = self.providers[r.get("provider", self.default_provider)]
+        return enc, prov
+
+
+class CommandDeliveryManager(BackgroundTaskComponent):
+    def __init__(self, engine: CommandDeliveryEngine):
+        super().__init__("command-delivery-manager")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        dm = await runtime.wait_for_engine("device-management", tenant_id)
+        delivered = runtime.metrics.counter("command_delivery.delivered")
+        failed = runtime.metrics.counter("command_delivery.failed")
+        undelivered_topic = engine.tenant_topic(TopicNaming.UNDELIVERED_COMMANDS)
+        consumer = runtime.bus.subscribe(
+            engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
+            group=f"{tenant_id}.command-delivery")
+        try:
+            while True:
+                for record in await consumer.poll(max_records=64, timeout=0.5):
+                    value = record.value
+                    if not isinstance(value, list):
+                        continue
+                    for ev in value:
+                        if isinstance(ev, DeviceCommandInvocation):
+                            ok = await self._deliver(dm, ev)
+                            if ok:
+                                delivered.inc()
+                            else:
+                                failed.inc()
+                                await runtime.bus.produce(
+                                    undelivered_topic, ev, key=ev.device_id)
+                consumer.commit()
+        finally:
+            consumer.close()
+
+    async def _deliver(self, dm, invocation: DeviceCommandInvocation) -> bool:
+        engine = self.engine
+        device = dm.get_device(invocation.device_id)
+        if device is None:
+            logger.warning("command for unknown device %s", invocation.device_id)
+            return False
+        dtype = dm.get_device_type(device.device_type_id)
+        command = dm.get_device_command(invocation.command_id) \
+            if invocation.command_id else None
+        try:
+            # route() raises on misconfigured encoder/provider names —
+            # that's data too, not a reason to kill the delivery loop
+            encoder, provider = engine.route(dtype.token if dtype else "")
+            payload = encoder.encode(device, command, invocation)
+            return await provider.deliver(device, payload)
+        except Exception:  # noqa: BLE001 - delivery errors are data
+            logger.exception("delivery failed for %s", device.token)
+            return False
+
+
+class CommandDeliveryService(Service):
+    identifier = "command-delivery"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> CommandDeliveryEngine:
+        return CommandDeliveryEngine(self, tenant)
+
+    def delivery(self, tenant_id: str) -> CommandDeliveryEngine:
+        return self.engine(tenant_id)  # type: ignore[return-value]

@@ -1,0 +1,117 @@
+"""device-registration service (reference: service-device-registration,
+[SURVEY.md §2.2]): auto-register unknown devices from registration
+payloads, applying per-tenant default device-type/area policies.
+
+Consumes the unregistered-device topic that inbound-processing splits off
+[SURVEY.md §3.2]. Two record shapes arrive:
+
+- `RegistrationBatch` (token-addressed, from the JSON decoder or an
+  explicit registration payload): devices are created with an assignment
+  if `allow_unknown_devices` is on; a device-type token in the request
+  overrides the tenant default.
+- `{"device_indices": ...}` (SWB1 events whose dense index is unknown):
+  indices are server-assigned, so these cannot be auto-registered — they
+  are counted and dropped (a hostile or misconfigured gateway, not a new
+  device).
+
+Tenant config section `device-registration`:
+  allow_unknown_devices: true
+  default_device_type: "<token>"     (required to auto-register)
+  default_area: "<token>" | null
+"""
+
+from __future__ import annotations
+
+import logging
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.batch import RegistrationBatch
+from sitewhere_tpu.domain.model import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceRegistrationEngine(TenantEngine):
+    def __init__(self, service: "DeviceRegistrationService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        cfg = tenant.section("device-registration", {})
+        self.allow_unknown = cfg.get("allow_unknown_devices", True)
+        self.default_device_type = cfg.get("default_device_type")
+        self.default_area = cfg.get("default_area")
+        self.manager = RegistrationManager(self)
+        self.add_child(self.manager)
+
+
+class RegistrationManager(BackgroundTaskComponent):
+    """(reference: RegistrationManager)"""
+
+    def __init__(self, engine: DeviceRegistrationEngine):
+        super().__init__("registration-manager")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        dm = await runtime.wait_for_engine("device-management", tenant_id)
+        registered = runtime.metrics.counter("registration.devices_registered")
+        rejected = runtime.metrics.counter("registration.requests_rejected")
+        unknown_idx = runtime.metrics.counter("registration.unknown_indices")
+        consumer = runtime.bus.subscribe(
+            engine.tenant_topic(TopicNaming.UNREGISTERED_DEVICES),
+            group=f"{tenant_id}.device-registration")
+        try:
+            while True:
+                for record in await consumer.poll(max_records=64, timeout=0.5):
+                    value = record.value
+                    if isinstance(value, RegistrationBatch):
+                        n = self._register(dm, value)
+                        registered.inc(n)
+                        if n < len(value):
+                            rejected.inc(len(value) - n)
+                    elif isinstance(value, dict) and "device_indices" in value:
+                        unknown_idx.inc(len(value["device_indices"]))
+                consumer.commit()
+        finally:
+            consumer.close()
+
+    def _register(self, dm, batch: RegistrationBatch) -> int:
+        engine = self.engine
+        if not engine.allow_unknown:
+            return 0
+        dt_token = batch.device_type_token or engine.default_device_type
+        if not dt_token:
+            logger.warning("registration: no device type for %s",
+                           batch.device_tokens)
+            return 0
+        dt = dm.get_device_type_by_token(dt_token)
+        if dt is None:
+            # first sight of the default type: create it (dataset-template
+            # analog — a fresh tenant needs no manual pre-seeding)
+            dt = dm.create_device_type(DeviceType(token=dt_token, name=dt_token))
+        area_id = None
+        if batch.area_token or engine.default_area:
+            area = dm.get_area_by_token(batch.area_token or engine.default_area)
+            area_id = area.id if area else None
+        count = 0
+        for token in batch.device_tokens:
+            if dm.get_device_by_token(token) is not None:
+                continue  # already registered (at-least-once redelivery)
+            device = dm.create_device(Device(
+                token=token, device_type_id=dt.id,
+                metadata=dict(batch.metadata)))
+            dm.create_device_assignment(DeviceAssignment(
+                device_id=device.id, area_id=area_id, token=f"{token}-auto"))
+            count += 1
+        return count
+
+
+class DeviceRegistrationService(Service):
+    identifier = "device-registration"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> DeviceRegistrationEngine:
+        return DeviceRegistrationEngine(self, tenant)

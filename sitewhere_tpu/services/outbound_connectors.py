@@ -1,0 +1,253 @@
+"""outbound-connectors service (reference: service-outbound-connectors,
+[SURVEY.md §2.2]): fan persisted/enriched events out to external systems
+with per-connector filtering.
+
+The reference ships MQTT/Solr/AzureEventHub/AmazonSQS/InitialState/dweet/
+Groovy connectors; the capability surface here is the pluggable connector
+registry + filter chain. Built-ins:
+
+- `memory`: bounded in-proc sink (test double / recent-events buffer)
+- `jsonl`: append JSON-lines to a file (the generic external-system
+  bridge; anything that tails a file or a named pipe can consume it)
+- `topic`: republish (optionally filtered) onto another bus topic —
+  composition primitive for custom pipelines
+- `callable`: wrap any async function (the Groovy-connector analog)
+
+Filters (reference: IDeviceEventFilter): event-kind allowlist, device
+allowlist (by index range or explicit set), score threshold for
+ScoredBatch records. Filters compose with AND semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.batch import (
+    AlertBatch,
+    LocationBatch,
+    MeasurementBatch,
+    ScoredBatch,
+)
+from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.service import Service, TenantEngine
+
+logger = logging.getLogger(__name__)
+
+
+def _kind(value) -> str:
+    if isinstance(value, MeasurementBatch):
+        return "measurements"
+    if isinstance(value, LocationBatch):
+        return "locations"
+    if isinstance(value, AlertBatch):
+        return "alerts"
+    if isinstance(value, ScoredBatch):
+        return "scored"
+    if isinstance(value, list):
+        return "events"
+    return "unknown"
+
+
+class EventFilter:
+    """AND-composed record filter (reference: IDeviceEventFilter)."""
+
+    def __init__(self, kinds: Optional[list[str]] = None,
+                 device_indices: Optional[list[int]] = None,
+                 min_score: Optional[float] = None):
+        self.kinds = set(kinds) if kinds else None
+        self.devices = set(device_indices) if device_indices else None
+        self.min_score = min_score
+
+    def apply(self, value):
+        """Returns the (possibly narrowed) record, or None to drop it."""
+        if self.kinds is not None and _kind(value) not in self.kinds:
+            return None
+        if self.devices is not None and hasattr(value, "device_index"):
+            mask = np.isin(value.device_index, list(self.devices))
+            if not mask.any():
+                return None
+            if not mask.all() and hasattr(value, "select"):
+                value = value.select(mask)
+        if self.min_score is not None and isinstance(value, ScoredBatch):
+            mask = value.score >= self.min_score
+            if not mask.any():
+                return None
+            value = ScoredBatch(value.ctx, value.device_index[mask],
+                                value.score[mask], value.is_anomaly[mask],
+                                value.ts[mask], value.model_version)
+        return value
+
+
+def record_to_jsonable(value) -> dict:
+    """Wire representation for external sinks."""
+    kind = _kind(value)
+    out: dict = {"kind": kind, "exported_at": time.time()}
+    if isinstance(value, (MeasurementBatch, LocationBatch, ScoredBatch, AlertBatch)):
+        out["count"] = len(value)
+        out["device_index"] = value.device_index.tolist()
+        if isinstance(value, MeasurementBatch):
+            out["value"] = value.value.tolist()
+            out["ts"] = value.ts.tolist()
+        elif isinstance(value, LocationBatch):
+            out["lat"] = value.latitude.tolist()
+            out["lon"] = value.longitude.tolist()
+        elif isinstance(value, ScoredBatch):
+            out["score"] = [round(float(s), 4) for s in value.score]
+            out["is_anomaly"] = value.is_anomaly.tolist()
+        elif isinstance(value, AlertBatch):
+            out["level"] = value.level.tolist()
+            out["type"] = list(value.type)
+            out["message"] = list(value.message)
+    elif isinstance(value, list):
+        from sitewhere_tpu.domain.events import event_to_dict
+
+        out["events"] = [event_to_dict(ev) for ev in value]
+    return out
+
+
+class Connector:
+    """Base connector: filter + sink. Subclass or use the built-ins."""
+
+    def __init__(self, name: str, filter: Optional[EventFilter] = None):
+        self.name = name
+        self.filter = filter or EventFilter()
+
+    async def process(self, value) -> None:
+        narrowed = self.filter.apply(value)
+        if narrowed is not None:
+            await self.sink(narrowed)
+
+    async def sink(self, value) -> None:  # pragma: no cover - override
+        raise NotImplementedError
+
+
+class MemoryConnector(Connector):
+    def __init__(self, name: str, filter: Optional[EventFilter] = None,
+                 retention: int = 1000):
+        super().__init__(name, filter)
+        self.records: list = []
+        self.retention = retention
+
+    async def sink(self, value) -> None:
+        self.records.append(value)
+        if len(self.records) > self.retention:
+            del self.records[: len(self.records) - self.retention]
+
+
+class JsonlConnector(Connector):
+    def __init__(self, name: str, path: str,
+                 filter: Optional[EventFilter] = None):
+        super().__init__(name, filter)
+        self.path = path
+        self._fh = open(path, "a", buffering=1)
+
+    async def sink(self, value) -> None:
+        self._fh.write(json.dumps(record_to_jsonable(value)) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class TopicConnector(Connector):
+    def __init__(self, name: str, bus, topic: str,
+                 filter: Optional[EventFilter] = None):
+        super().__init__(name, filter)
+        self.bus = bus
+        self.topic = topic
+
+    async def sink(self, value) -> None:
+        await self.bus.produce(self.topic, value, key=self.name)
+
+
+class CallableConnector(Connector):
+    def __init__(self, name: str, fn: Callable[[object], Awaitable[None]],
+                 filter: Optional[EventFilter] = None):
+        super().__init__(name, filter)
+        self.fn = fn
+
+    async def sink(self, value) -> None:
+        await self.fn(value)
+
+
+class OutboundConnectorsEngine(TenantEngine):
+    """(reference: OutboundConnectorsManager)"""
+
+    def __init__(self, service: "OutboundConnectorsService", tenant: TenantConfig):
+        super().__init__(service, tenant)
+        self.connectors: dict[str, Connector] = {}
+        cfg = tenant.section("outbound-connectors", {})
+        for c in cfg.get("connectors", []):
+            self.add_connector_config(c)
+        self.manager = OutboundManager(self)
+        self.add_child(self.manager)
+
+    def add_connector_config(self, c: dict) -> Connector:
+        filt = EventFilter(kinds=c.get("kinds"),
+                          device_indices=c.get("devices"),
+                          min_score=c.get("min_score"))
+        kind = c.get("kind", "memory")
+        name = c.get("name", f"{kind}-{len(self.connectors)}")
+        if kind == "memory":
+            conn = MemoryConnector(name, filt, retention=c.get("retention", 1000))
+        elif kind == "jsonl":
+            conn = JsonlConnector(name, c["path"], filt)
+        elif kind == "topic":
+            conn = TopicConnector(name, self.runtime.bus, c["topic"], filt)
+        else:
+            raise ValueError(f"unknown connector kind {kind!r}")
+        self.connectors[name] = conn
+        return conn
+
+    def add_connector(self, connector: Connector) -> None:
+        """Extension point for custom (e.g. MQTT) connectors."""
+        self.connectors[connector.name] = connector
+
+
+class OutboundManager(BackgroundTaskComponent):
+    def __init__(self, engine: OutboundConnectorsEngine):
+        super().__init__("outbound-manager")
+        self.engine = engine
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        forwarded = runtime.metrics.meter("outbound.records_forwarded")
+        consumer = runtime.bus.subscribe(
+            [engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
+             engine.tenant_topic(TopicNaming.SCORED_EVENTS)],
+            group=f"{tenant_id}.outbound-connectors")
+        try:
+            while True:
+                for record in await consumer.poll(max_records=64, timeout=0.5):
+                    for connector in engine.connectors.values():
+                        try:
+                            await connector.process(record.value)
+                        except Exception:  # noqa: BLE001 - connector isolated
+                            logger.exception("connector %s failed",
+                                             connector.name)
+                    forwarded.mark(1)
+                consumer.commit()
+        finally:
+            consumer.close()
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        for connector in self.engine.connectors.values():
+            if isinstance(connector, JsonlConnector):
+                connector.close()
+
+
+class OutboundConnectorsService(Service):
+    identifier = "outbound-connectors"
+    multitenant = True
+
+    def create_tenant_engine(self, tenant: TenantConfig) -> OutboundConnectorsEngine:
+        return OutboundConnectorsEngine(self, tenant)

@@ -1,0 +1,149 @@
+"""Model checkpointing via Orbax [SURVEY.md §5.4].
+
+The reference has no ML checkpoints (its resume story is Kafka offsets +
+durable event store); the rebuild adds Orbax for model params + metadata,
+with a version-numbered directory layout and latest-pointer so the
+scoring server can hot-swap on rollout:
+
+    <root>/<tenant>/<model_name>/v<N>/   (orbax PyTree checkpoint)
+
+Falls back to numpy .npz if orbax is unavailable (minimal installs).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+try:
+    import orbax.checkpoint as ocp
+except ImportError:  # pragma: no cover
+    ocp = None
+
+
+def _orbax_save(path: str, params: Any) -> None:
+    with ocp.PyTreeCheckpointer() as checkpointer:
+        checkpointer.save(path, params)
+
+
+def _orbax_restore(path: str) -> Any:
+    with ocp.PyTreeCheckpointer() as checkpointer:
+        return checkpointer.restore(path)
+
+
+def _run_outside_loop(fn):
+    """Run `fn` on a thread with no running event loop.
+
+    Orbax's sync API drives asyncio internally; invoked from a thread
+    that already runs a loop it corrupts that loop's ready queue
+    (observed: IndexError pop from empty deque in BaseEventLoop). Params
+    are numpy by the time we get here, so the thread does file IO only —
+    no JAX runtime calls cross the thread boundary.
+    """
+    import asyncio
+    import threading
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return fn()  # no loop → safe to run inline
+    result: list = [None, None]
+
+    def target():
+        try:
+            result[0] = fn()
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            result[1] = exc
+
+    t = threading.Thread(target=target, name="orbax-io")
+    t.start()
+    t.join()
+    if result[1] is not None:
+        raise result[1]
+    return result[0]
+
+
+class CheckpointStore:
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _model_dir(self, tenant_id: str, model_name: str) -> str:
+        d = os.path.join(self.root, tenant_id, model_name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def versions(self, tenant_id: str, model_name: str) -> list[int]:
+        d = self._model_dir(tenant_id, model_name)
+        out = []
+        for name in os.listdir(d):
+            m = re.fullmatch(r"v(\d+)", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def save(self, tenant_id: str, model_name: str, params: Any,
+             metadata: Optional[dict] = None) -> int:
+        """Save params as the next version; returns the version number."""
+        versions = self.versions(tenant_id, model_name)
+        version = (versions[-1] + 1) if versions else 1
+        d = os.path.join(self._model_dir(tenant_id, model_name), f"v{version}")
+        params = jax.tree.map(np.asarray, params)
+        if ocp is not None:
+            _run_outside_loop(lambda: _orbax_save(os.path.join(d, "params"),
+                                                  params))
+        else:  # pragma: no cover
+            os.makedirs(d, exist_ok=True)
+            flat, _ = jax.tree_util.tree_flatten_with_path(params)
+            np.savez(os.path.join(d, "params.npz"),
+                     **{jax.tree_util.keystr(k): v for k, v in flat})
+        meta = {"version": version, "saved_at": time.time(),
+                "model": model_name, **(metadata or {})}
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+        logger.info("checkpoint %s/%s v%d saved", tenant_id, model_name, version)
+        return version
+
+    def load(self, tenant_id: str, model_name: str,
+             version: Optional[int] = None) -> tuple[Any, dict]:
+        """Load (params, metadata) for a version (default: latest)."""
+        versions = self.versions(tenant_id, model_name)
+        if not versions:
+            raise FileNotFoundError(
+                f"no checkpoints for {tenant_id}/{model_name} under {self.root}")
+        version = version if version is not None else versions[-1]
+        d = os.path.join(self._model_dir(tenant_id, model_name), f"v{version}")
+        with open(os.path.join(d, "metadata.json")) as f:
+            meta = json.load(f)
+        if ocp is not None and os.path.isdir(os.path.join(d, "params")):
+            params = _run_outside_loop(
+                lambda: _orbax_restore(os.path.join(d, "params")))
+        else:  # pragma: no cover
+            data = np.load(os.path.join(d, "params.npz"))
+            params = {}
+            for k in data.files:  # keystr like "['lstm0']['wx']" -> nested
+                node = params
+                keys = re.findall(r"\['([^']+)'\]", k)
+                for key in keys[:-1]:
+                    node = node.setdefault(key, {})
+                node[keys[-1]] = data[k]
+        return params, meta
+
+    def prune(self, tenant_id: str, model_name: str, keep: int = 3) -> None:
+        """Delete all but the newest `keep` versions."""
+        import shutil
+
+        versions = self.versions(tenant_id, model_name)
+        for v in versions[:-keep] if keep > 0 else versions:
+            shutil.rmtree(os.path.join(
+                self._model_dir(tenant_id, model_name), f"v{v}"),
+                ignore_errors=True)

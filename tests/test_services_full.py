@@ -1,0 +1,325 @@
+"""Tests for the full service roster: registration, command delivery,
+outbound connectors, batch operations (incl. training trigger),
+schedules, labels [SURVEY.md §2.2 parity]."""
+
+import asyncio
+import contextlib
+import json
+import time
+
+import numpy as np
+
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.events import DeviceCommandInvocation
+from sitewhere_tpu.domain.model import (
+    BatchOperationStatus,
+    DeviceCommand,
+    DeviceType,
+    Schedule,
+    ScheduledJob,
+)
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.services import (
+    AssetManagementService,
+    BatchOperationsService,
+    CommandDeliveryService,
+    DeviceManagementService,
+    DeviceRegistrationService,
+    DeviceStateService,
+    EventManagementService,
+    EventSourcesService,
+    InboundProcessingService,
+    LabelGenerationService,
+    OutboundConnectorsService,
+    RuleProcessingService,
+    ScheduleManagementService,
+)
+from sitewhere_tpu.services.schedule_management import cron_matches
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+from tests.test_pipeline import wait_until
+
+
+@contextlib.asynccontextmanager
+async def full_instance(sections: dict | None = None, num_devices: int = 20,
+                        tmp_path=None):
+    sections = dict(sections or {})
+    sections.setdefault("rule-processing", {
+        "model": "zscore", "model_config": {"window": 16},
+        "batch_window_ms": 1.0, "buckets": [256]})
+    if tmp_path is not None:
+        sections.setdefault("batch-operations",
+                            {"checkpoint_root": str(tmp_path / "ckpt")})
+    rt = ServiceRuntime(InstanceSettings(instance_id="full"))
+    for cls in (DeviceManagementService, AssetManagementService,
+                EventSourcesService, InboundProcessingService,
+                EventManagementService, DeviceStateService,
+                RuleProcessingService, DeviceRegistrationService,
+                CommandDeliveryService, OutboundConnectorsService,
+                BatchOperationsService, ScheduleManagementService,
+                LabelGenerationService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    await rt.add_tenant(TenantConfig(tenant_id="acme", sections=sections))
+    dm = rt.api("device-management").management("acme")
+    dt = DeviceType(token="thermo", name="Thermometer")
+    dm.bootstrap_fleet(dt, num_devices)
+    try:
+        yield rt
+    finally:
+        await rt.stop()
+
+
+def test_auto_registration_via_json(run):
+    async def main():
+        sections = {"device-registration": {
+            "allow_unknown_devices": True,
+            "default_device_type": "auto-type"}}
+        async with full_instance(sections) as rt:
+            sources = rt.api("event-sources").engine("acme")
+            sources.add_receiver({"kind": "queue", "decoder": "json",
+                                  "name": "json-in"})
+            await sources.receiver("json-in").start()
+            payload = json.dumps({"requests": [
+                {"type": "registration", "device": "new-dev-1",
+                 "deviceType": "auto-type"},
+                {"type": "measurement", "device": "never-seen", "value": 5.0},
+            ]}).encode()
+            await sources.receiver("json-in").submit(payload)
+
+            dm = rt.api("device-management").management("acme")
+            await wait_until(
+                lambda: dm.get_device_by_token("new-dev-1") is not None)
+            await wait_until(
+                lambda: dm.get_device_by_token("never-seen") is not None)
+            # auto-registered device got an active assignment
+            d = dm.get_device_by_token("new-dev-1")
+            assert dm.get_active_assignments_for_device(d.id)
+            # redelivery is idempotent
+            await sources.receiver("json-in").submit(payload)
+            await asyncio.sleep(0.1)
+            assert len([x for x in dm.list_devices(page_size=1000)
+                        if x.token == "new-dev-1"]) == 1
+
+    run(main())
+
+
+def test_command_delivery_roundtrip(run):
+    async def main():
+        async with full_instance() as rt:
+            dm = rt.api("device-management").management("acme")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="reboot", device_type_id=dt.id, name="reboot",
+                parameters=(("delay_s", "int64", False),)))
+            device = dm.get_device_by_token("dev-3")
+            assignment = dm.get_active_assignments_for_device(device.id)[0]
+
+            em = rt.api("event-management").management("acme")
+            inv = DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id, parameter_values={"delay_s": 5})
+            await em.add_command_invocations([inv])
+
+            delivery = rt.api("command-delivery").delivery("acme")
+            provider = delivery.providers["queue"]
+            await wait_until(lambda: provider.inbox("dev-3"))
+            msg = json.loads(provider.inbox("dev-3")[0])
+            assert msg["command"] == "reboot"
+            assert msg["parameters"] == {"delay_s": 5}
+            # invocation is also persisted + queryable (reference parity)
+            assert em.list_command_invocations()[0].id == inv.id
+
+    run(main())
+
+
+def test_outbound_connectors_filtering(run, tmp_path):
+    async def main():
+        sections = {"outbound-connectors": {"connectors": [
+            {"kind": "memory", "name": "all"},
+            {"kind": "memory", "name": "only-anomalies", "kinds": ["scored"],
+             "min_score": 4.0},
+            {"kind": "jsonl", "name": "export",
+             "path": str(tmp_path / "out.jsonl"), "kinds": ["measurements"]},
+        ]}}
+        async with full_instance(sections, num_devices=50) as rt:
+            sim = DeviceSimulator(SimConfig(num_devices=50, seed=5),
+                                  tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme").receiver("default")
+            for k in range(20):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            sim.cfg = SimConfig(num_devices=50, seed=5, anomaly_rate=0.2,
+                                anomaly_magnitude=15.0)
+            payload, truth = sim.payload(t=21 * 60.0)
+            await receiver.submit(payload)
+
+            engine = rt.api("outbound-connectors").engine("acme")
+            anomalies = engine.connectors["only-anomalies"]
+            await wait_until(lambda: anomalies.records, timeout=15.0)
+            assert all(r.score.min() >= 4.0 for r in anomalies.records)
+            assert engine.connectors["all"].records
+
+            lines = (tmp_path / "out.jsonl").read_text().strip().splitlines()
+            assert len(lines) >= 20
+            assert json.loads(lines[0])["kind"] == "measurements"
+
+    run(main())
+
+
+def test_batch_command_operation(run):
+    async def main():
+        async with full_instance(num_devices=25) as rt:
+            dm = rt.api("device-management").management("acme")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="ping", device_type_id=dt.id, name="ping"))
+            devices = dm.list_devices(page_size=100)
+            batch = rt.api("batch-operations").operations("acme")
+            op = await batch.submit_command_operation(
+                [d.id for d in devices], cmd.id)
+            op = await batch.wait_for_operation(op.id, timeout=30.0)
+            assert op.processing_status == BatchOperationStatus.FINISHED_SUCCESSFULLY
+            elements = batch.list_batch_elements(op.id)
+            assert len(elements) == 25
+            assert all(e.processing_status.value == "succeeded"
+                       for e in elements)
+            # every device got its command delivered
+            provider = rt.api("command-delivery").delivery("acme").providers["queue"]
+            await wait_until(lambda: len(provider.delivered) == 25)
+
+    run(main())
+
+
+def test_training_operation_trains_checkpoints_and_hot_swaps(run, tmp_path):
+    async def main():
+        sections = {"rule-processing": {
+            "model": "lstm", "model_config": {"window": 16, "hidden": 8},
+            "batch_window_ms": 1.0, "buckets": [256]}}
+        async with full_instance(sections, num_devices=30,
+                                 tmp_path=tmp_path) as rt:
+            em = rt.api("event-management").management("acme")
+            sim = DeviceSimulator(SimConfig(num_devices=30, seed=2),
+                                  tenant_id="acme")
+            # history straight into the store (training data)
+            for k in range(200):
+                em.telemetry.append_measurements(sim.tick(t=60.0 * k)[0])
+
+            rule_engine = rt.api("rule-processing").engine("acme")
+            v0 = rule_engine.session.version
+            batch = rt.api("batch-operations").operations("acme")
+            op = await batch.submit_training_operation(
+                "lstm", steps=30, batch_size=64)
+            op = await batch.wait_for_operation(op.id, timeout=120.0)
+            assert op.processing_status == BatchOperationStatus.FINISHED_SUCCESSFULLY
+            result = op.parameters["result"]
+            assert result["windows"] > 0
+            assert result["losses"][-1] < result["losses"][0]
+            assert result["hot_swapped"] is True
+            assert rule_engine.session.version == v0 + 1
+
+            # checkpoint is on disk and loadable
+            from sitewhere_tpu.training.checkpoint import CheckpointStore
+            store = CheckpointStore(str(tmp_path / "ckpt"))
+            params, meta = store.load("acme", "lstm")
+            assert meta["version"] == result["checkpoint_version"]
+            assert "head" in params
+
+    run(main())
+
+
+def test_schedule_fires_command(run):
+    async def main():
+        async with full_instance() as rt:
+            dm = rt.api("device-management").management("acme")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="beep", device_type_id=dt.id, name="beep"))
+            device = dm.get_device_by_token("dev-0")
+            sched = rt.api("schedule-management").schedules("acme")
+            sched.tick_s = 0.05
+            s = sched.create_schedule(Schedule(
+                name="every-tick", trigger_type="simple",
+                trigger_configuration={"repeat_interval_s": 0.1,
+                                       "repeat_count": 2}))
+            sched.create_scheduled_job(ScheduledJob(
+                schedule_id=s.id, job_type="command-invocation",
+                configuration={"device_id": device.id, "command_id": cmd.id}))
+            provider = rt.api("command-delivery").delivery("acme").providers["queue"]
+            await wait_until(lambda: len(provider.inbox("dev-0")) >= 2,
+                             timeout=10.0)
+            # repeat_count=2 → at most 3 fires (first + 2 repeats)
+            await asyncio.sleep(0.3)
+            assert len(provider.inbox("dev-0")) <= 3
+
+    run(main())
+
+
+def test_cron_matcher():
+    from datetime import datetime
+
+    assert cron_matches("* * * * *", datetime(2026, 7, 29, 10, 30))
+    assert cron_matches("*/15 * * * *", datetime(2026, 7, 29, 10, 30))
+    assert not cron_matches("*/15 * * * *", datetime(2026, 7, 29, 10, 31))
+    assert cron_matches("30 10 * * *", datetime(2026, 7, 29, 10, 30))
+    assert not cron_matches("30 11 * * *", datetime(2026, 7, 29, 10, 30))
+    assert cron_matches("0 0 29 7 *", datetime(2026, 7, 29, 0, 0))
+    # 2026-07-29 is a Wednesday → POSIX cron dow 3 (0=Sunday)
+    assert cron_matches("* * * * 3", datetime(2026, 7, 29, 5, 0))
+    assert not cron_matches("* * * * 2", datetime(2026, 7, 29, 5, 0))
+    # Sunday matches both 0 and 7 (2026-08-02 is a Sunday)
+    assert cron_matches("* * * * 0", datetime(2026, 8, 2, 5, 0))
+    assert cron_matches("* * * * 7", datetime(2026, 8, 2, 5, 0))
+
+
+def test_label_generation(run):
+    async def main():
+        async with full_instance() as rt:
+            labels = rt.api("label-generation").labels("acme")
+            svg = labels.device_label("dev-7").decode()
+            assert svg.startswith("<svg")
+            assert "DEV-7" in svg          # token text
+            assert svg.count("<rect") > 20  # barcode bars
+            from sitewhere_tpu.services.label_generation import code39_svg
+            bars_a, _ = code39_svg("AAA")
+            bars_b, _ = code39_svg("BBB")
+            assert bars_a != bars_b
+
+    run(main())
+
+
+def test_chaos_service_restart_mid_stream(run):
+    """Failure-recovery fixture [SURVEY.md §5.3]: kill + restart a
+    mid-pipeline service while events flow; at-least-once semantics mean
+    everything sent is eventually persisted."""
+
+    async def main():
+        async with full_instance(num_devices=40) as rt:
+            sim = DeviceSimulator(SimConfig(num_devices=40), tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme").receiver("default")
+            em_service = rt.services["event-management"]
+
+            for k in range(5):
+                await receiver.submit(sim.payload(t=100.0 + k)[0])
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events >= 200)
+
+            # kill event-management mid-stream
+            await em_service.stop()
+            for k in range(5):
+                await receiver.submit(sim.payload(t=200.0 + k)[0])
+            await asyncio.sleep(0.2)
+
+            # restart: engine rebuilds, consumer resumes from committed
+            # offsets, the 5 in-flight batches are persisted
+            await em_service.initialize()
+            await em_service.start()
+            await wait_until(
+                lambda: "acme" in em_service.engines
+                and em_service.engines["acme"].spi is not None
+                and em_service.engines["acme"].telemetry.total_events >= 200,
+                timeout=15.0)
+            em2 = rt.api("event-management").management("acme")
+            await wait_until(lambda: em2.telemetry.total_events == 200,
+                             timeout=15.0)
+
+    run(main())
