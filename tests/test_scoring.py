@@ -109,6 +109,13 @@ def test_e2e_scoring_alerts_in_pipeline(run):
                 await receiver.submit(payload)
             em = rt.api("event-management").management("acme")
             await wait_until(lambda: em.telemetry.total_events == 4000)
+            # let scoring drain history before the anomaly tick: otherwise
+            # a history row flushed together with the anomaly shares its
+            # post-anomaly window and yields extra (correct-but-untracked)
+            # alerts for the same devices
+            session = rt.api("rule-processing").engine("acme").session
+            await wait_until(lambda: session.latency.count >= 4000,
+                             timeout=30.0)
 
             # anomaly tick
             sim.cfg = SimConfig(num_devices=100, seed=11, anomaly_rate=0.1,
