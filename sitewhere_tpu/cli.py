@@ -1,0 +1,214 @@
+"""swx — the platform CLI [SURVEY.md §1 L8].
+
+The reference has no real CLI (deploy was k8s/docker-compose); the
+rebuild ships one:
+
+  swx run [--config instance.yaml] [--port 8080]   run a full instance
+  swx simulate --host H --port P --devices N       stream SWB1 at a gateway
+  swx bench [...]                                  run the benchmark
+  swx demo                                         run + simulate + score, one process
+
+`run` starts every service, creates tenants from the YAML (or a default
+tenant), and serves REST until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import sys
+import time
+
+
+def _build_runtime(settings, tenants):
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.services import (
+        AssetManagementService,
+        BatchOperationsService,
+        CommandDeliveryService,
+        DeviceManagementService,
+        DeviceRegistrationService,
+        DeviceStateService,
+        EventManagementService,
+        EventSourcesService,
+        InboundProcessingService,
+        InstanceManagementService,
+        LabelGenerationService,
+        OutboundConnectorsService,
+        RuleProcessingService,
+        ScheduleManagementService,
+    )
+
+    rt = ServiceRuntime(settings)
+    for cls in (InstanceManagementService, DeviceManagementService,
+                AssetManagementService, EventSourcesService,
+                InboundProcessingService, EventManagementService,
+                DeviceStateService, RuleProcessingService,
+                DeviceRegistrationService, CommandDeliveryService,
+                OutboundConnectorsService, BatchOperationsService,
+                ScheduleManagementService, LabelGenerationService):
+        rt.add_service(cls(rt))
+    return rt
+
+
+async def cmd_run(args) -> int:
+    from sitewhere_tpu.config import InstanceSettings, TenantConfig, load_yaml_config
+
+    if args.config:
+        settings, tenants = load_yaml_config(args.config)
+    else:
+        settings = InstanceSettings.from_env()
+        tenants = [TenantConfig(tenant_id="default", sections={
+            "rule-processing": {"model": "zscore"},
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "tcp", "decoder": "swb1", "name": "gateway",
+                 "port": args.gateway_port}]}})]
+    if args.port is not None:
+        import dataclasses
+
+        settings = dataclasses.replace(settings, rest_port=args.port)
+
+    rt = _build_runtime(settings, tenants)
+    await rt.start()
+    for tenant in tenants:
+        im = rt.services["instance-management"]
+        await im.create_tenant(tenant.tenant_id, tenant.name,
+                               dict(tenant.sections),
+                               tuple(tenant.authorized_user_ids))
+    rest = rt.services["instance-management"].rest
+    print(f"swx instance {settings.instance_id} up; "
+          f"REST on {rest.host}:{rest.port}" if rest else "REST disabled",
+          flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover
+            pass
+    await stop.wait()
+    await rt.stop()
+    return 0
+
+
+async def cmd_simulate(args) -> int:
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    sim = DeviceSimulator(SimConfig(num_devices=args.devices,
+                                    anomaly_rate=args.anomaly_rate),
+                          tenant_id=args.tenant)
+    reader, writer = await asyncio.open_connection(args.host, args.port)
+    sent = 0
+    t0 = time.monotonic()
+    interval = 1.0 / args.rate if args.rate else 0.0
+    try:
+        while args.seconds <= 0 or time.monotonic() - t0 < args.seconds:
+            payload, _ = sim.payload()
+            writer.write(len(payload).to_bytes(4, "little") + payload)
+            await writer.drain()
+            sent += args.devices
+            if interval:
+                await asyncio.sleep(interval)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        writer.close()
+    rate = sent / max(time.monotonic() - t0, 1e-9)
+    print(f"sent {sent} events ({rate:,.0f}/s)")
+    return 0
+
+
+async def cmd_demo(args) -> int:
+    """Self-contained demo: instance + fleet + anomalies, report alerts."""
+    from sitewhere_tpu.config import InstanceSettings
+    from sitewhere_tpu.domain.model import DeviceType
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    settings = InstanceSettings(rest_port=args.port or 0)
+    rt = _build_runtime(settings, [])
+    await rt.start()
+    im = rt.services["instance-management"]
+    await im.create_tenant("demo", "Demo", {
+        "rule-processing": {"model": "zscore", "model_config": {"window": 32},
+                            "threshold": 5.0, "batch_window_ms": 2.0,
+                            "buckets": [args.devices]}})
+    dm = rt.api("device-management").management("demo")
+    dm.bootstrap_fleet(DeviceType(token="thermo", name="Thermometer"),
+                       args.devices)
+    sim = DeviceSimulator(SimConfig(num_devices=args.devices,
+                                    anomaly_rate=0.002,
+                                    anomaly_magnitude=12.0), tenant_id="demo")
+    receiver = rt.api("event-sources").engine("demo").receiver("default")
+    session = rt.api("rule-processing").engine("demo").session
+    while not session.ready:
+        await asyncio.sleep(0.05)
+    print(f"demo: {args.devices} devices streaming for {args.seconds}s ...",
+          flush=True)
+    t0 = time.monotonic()
+    k = 0
+    while time.monotonic() - t0 < args.seconds:
+        await receiver.submit(sim.payload(t=time.time())[0])
+        k += 1
+        await asyncio.sleep(0.01)
+    await asyncio.sleep(1.0)
+    em = rt.api("event-management").management("demo")
+    alerts = em.list_alerts()
+    snap = rt.metrics.snapshot()
+    print(json.dumps({
+        "events_sent": k * args.devices,
+        "events_persisted": em.telemetry.total_events,
+        "model_alerts": len(alerts),
+        "scoring_rate_10s": snap["scoring.events_scored"]["rate_10s"],
+        "p99_ms": round(snap["scoring.e2e_latency_s"]["p99"] * 1e3, 2),
+    }, indent=2))
+    await rt.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="swx")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run a full instance")
+    p_run.add_argument("--config", help="instance YAML")
+    p_run.add_argument("--port", type=int, help="REST port")
+    p_run.add_argument("--gateway-port", type=int, default=47800)
+
+    p_sim = sub.add_parser("simulate", help="stream SWB1 at a TCP gateway")
+    p_sim.add_argument("--host", default="127.0.0.1")
+    p_sim.add_argument("--port", type=int, default=47800)
+    p_sim.add_argument("--devices", type=int, default=1000)
+    p_sim.add_argument("--tenant", default="default")
+    p_sim.add_argument("--seconds", type=float, default=10.0)
+    p_sim.add_argument("--rate", type=float, default=10.0,
+                       help="batches per second (0 = unthrottled)")
+    p_sim.add_argument("--anomaly-rate", type=float, default=0.0)
+
+    p_demo = sub.add_parser("demo", help="one-process end-to-end demo")
+    p_demo.add_argument("--devices", type=int, default=1000)
+    p_demo.add_argument("--seconds", type=float, default=5.0)
+    p_demo.add_argument("--port", type=int)
+
+    sub.add_parser("bench", help="run the benchmark (see bench.py flags)")
+
+    args, extra = parser.parse_known_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.cmd == "bench":
+        import subprocess
+
+        return subprocess.call([sys.executable, "bench.py", *extra])
+    coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo}[args.cmd]
+    return asyncio.run(coro(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,6 +149,10 @@ class InMemoryDeviceManagement:
     def list_device_commands(self, device_type_id: str) -> list[DeviceCommand]:
         return [c for c in self.commands.values() if c.device_type_id == device_type_id]
 
+    def find_device_command_by_token(self, token: str) -> Optional[DeviceCommand]:
+        """Token-only lookup (REST batch/invocation convenience)."""
+        return self.commands.get_by_token(token)
+
     def create_device_status(self, status: DeviceStatus) -> DeviceStatus:
         return self.statuses.put(status)
 

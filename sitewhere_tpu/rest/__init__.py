@@ -1,0 +1,3 @@
+from sitewhere_tpu.rest.api import RestServer
+
+__all__ = ["RestServer"]

@@ -1,0 +1,793 @@
+"""REST facade (reference: Spring MVC controllers + Swagger + JWT in
+instance-management's web module — [SURVEY.md §1 L7, §2.2]).
+
+Dependency-free asyncio HTTP server exposing the SiteWhere-style API
+surface the configs need: JWT auth (`POST /api/jwt` with basic auth, then
+`Authorization: Bearer`), tenant scoping via the `X-SiteWhere-Tenant`
+header (reference: tenant token header), JSON bodies, and the resource
+routes listed in `ROUTES` below.
+
+Route naming follows the reference's REST layout (devicetypes, devices,
+assignments, areas, customers, assets, batch, schedules, tenants, users)
+so a reference client's calls map 1:1; responses are JSON with the same
+field names as the domain model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import re
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from sitewhere_tpu.domain.events import event_to_dict
+from sitewhere_tpu.domain.model import (
+    Area,
+    Asset,
+    AssetType,
+    Customer,
+    Device,
+    DeviceAssignment,
+    DeviceCommand,
+    DeviceType,
+    Schedule,
+    ScheduledJob,
+    Zone,
+    entity_to_dict,
+)
+from sitewhere_tpu.kernel.lifecycle import LifecycleComponent
+from sitewhere_tpu.kernel.security import (
+    AUTH_ADMIN_SCRIPTS,
+    AUTH_ADMIN_TENANTS,
+    AUTH_ADMIN_USERS,
+    AUTH_REST,
+    AuthContext,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, method: str, path: str, query: dict, headers: dict,
+                 body: bytes, auth: Optional[AuthContext]):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.auth = auth
+        self.params: dict[str, str] = {}
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as exc:
+            raise HttpError(400, f"invalid JSON body: {exc}") from exc
+
+    def qp(self, name: str, default=None):
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def int_qp(self, name: str, default: int) -> int:
+        try:
+            return int(self.qp(name, default))
+        except (TypeError, ValueError):
+            raise HttpError(400, f"query param {name} must be an integer")
+
+    def float_qp(self, name: str, default: float) -> float:
+        try:
+            return float(self.qp(name, default))
+        except (TypeError, ValueError):
+            raise HttpError(400, f"query param {name} must be a number")
+
+
+class RestServer(LifecycleComponent):
+    """The HTTP listener + router (hosted by instance-management)."""
+
+    def __init__(self, runtime, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        super().__init__("rest-server")
+        self.runtime = runtime
+        self.host = host or runtime.settings.rest_host
+        self.port = port if port is not None else runtime.settings.rest_port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._routes: list[tuple[str, re.Pattern, Callable, Optional[str]]] = []
+        self._install_routes()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def _do_start(self, monitor) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("REST listening on %s:%d", self.host, self.port)
+
+    async def _do_stop(self, monitor) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- http plumbing -----------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, target, _version = line.decode().split()
+                except ValueError:
+                    return
+                headers: dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                try:
+                    length = int(headers.get("content-length", 0) or 0)
+                    if length < 0:
+                        raise ValueError(length)
+                except ValueError:
+                    status, ctype, payload = 400, "application/json", _dumps(
+                        {"error": "invalid Content-Length", "status": 400})
+                    length = None
+                if length is not None and length > 8 * 1024 * 1024:
+                    status, ctype, payload = 413, "application/json", _dumps(
+                        {"error": "body too large", "status": 413})
+                    length = None
+                if length is not None:
+                    body = await reader.readexactly(length) if length else b""
+                    status, ctype, payload = await self._dispatch(
+                        method, target, headers, body)
+                conn = "keep-alive" if length is not None else "close"
+                writer.write(
+                    f"HTTP/1.1 {status} {_reason(status)}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: {conn}\r\n\r\n".encode() + payload)
+                await writer.drain()
+                if length is None:  # unread request body: can't reuse conn
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, method: str, target: str, headers: dict,
+                        body: bytes) -> tuple[int, str, bytes]:
+        parsed = urlparse(target)
+        path = parsed.path.rstrip("/") or "/"
+        query = parse_qs(parsed.query)
+        try:
+            auth = self._authenticate(headers, path, method)
+            req = Request(method, path, query, headers, body, auth)
+            for m, pattern, handler, authority in self._routes:
+                if m != method:
+                    continue
+                match = pattern.fullmatch(path)
+                if match is None:
+                    continue
+                if authority is not None:
+                    if req.auth is None:
+                        raise HttpError(401, "authentication required")
+                    if not req.auth.has_authority(authority):
+                        raise HttpError(403, f"requires {authority}")
+                req.params = match.groupdict()
+                result = await handler(req)
+                if isinstance(result, tuple):  # (content_type, bytes)
+                    return 200, result[0], result[1]
+                return 200, "application/json", _dumps(result)
+            raise HttpError(404, f"no route {method} {path}")
+        except HttpError as exc:
+            return exc.status, "application/json", _dumps(
+                {"error": exc.message, "status": exc.status})
+        except Exception as exc:  # noqa: BLE001 - don't leak stacks to clients
+            logger.exception("REST handler error for %s %s", method, target)
+            return 500, "application/json", _dumps(
+                {"error": f"internal error: {type(exc).__name__}", "status": 500})
+
+    def _authenticate(self, headers: dict, path: str,
+                      method: str) -> Optional[AuthContext]:
+        im = self.runtime.services.get("instance-management")
+        authz = headers.get("authorization", "")
+        if authz.lower().startswith("bearer ") and im is not None:
+            return im.validate(authz[7:].strip())
+        return None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _tenant_id(self, req: Request) -> str:
+        tenant = req.headers.get("x-sitewhere-tenant")
+        if not tenant:
+            raise HttpError(400, "X-SiteWhere-Tenant header required")
+        if tenant not in self.runtime.tenants:
+            raise HttpError(404, f"unknown tenant {tenant!r}")
+        return tenant
+
+    def _dm(self, req: Request):
+        return self.runtime.api("device-management").management(
+            self._tenant_id(req))
+
+    def _em(self, req: Request):
+        return self.runtime.api("event-management").management(
+            self._tenant_id(req))
+
+    def _im(self):
+        im = self.runtime.services.get("instance-management")
+        if im is None:
+            raise HttpError(503, "instance-management not available")
+        return im
+
+    def _engine(self, req: Request, service: str):
+        try:
+            return self.runtime.services[service].engine(self._tenant_id(req))
+        except KeyError as exc:
+            raise HttpError(503, f"{service} not available") from exc
+
+    def _device_by_token(self, req: Request, token: str) -> Device:
+        device = self._dm(req).get_device_by_token(token)
+        if device is None:
+            raise HttpError(404, f"unknown device {token!r}")
+        return device
+
+    # -- route table -------------------------------------------------------
+
+    def _route(self, method: str, pattern: str, handler: Callable,
+               authority: Optional[str] = AUTH_REST) -> None:
+        self._routes.append((method, re.compile(pattern), handler, authority))
+
+    def _install_routes(self) -> None:
+        r = self._route
+        # auth + instance
+        r("POST", r"/api/jwt", self.post_jwt, authority=None)
+        r("GET", r"/api/instance/health", self.get_health, authority=None)
+        r("GET", r"/api/instance/metrics", self.get_metrics)
+        r("GET", r"/api/instance/topics", self.get_topics)
+        # users / tenants
+        r("GET", r"/api/users", self.list_users, AUTH_ADMIN_USERS)
+        r("POST", r"/api/users", self.create_user, AUTH_ADMIN_USERS)
+        r("GET", r"/api/tenants", self.list_tenants)
+        r("POST", r"/api/tenants", self.create_tenant, AUTH_ADMIN_TENANTS)
+        r("GET", r"/api/tenants/(?P<token>[^/]+)", self.get_tenant)
+        r("PUT", r"/api/tenants/(?P<token>[^/]+)", self.update_tenant,
+          AUTH_ADMIN_TENANTS)
+        r("DELETE", r"/api/tenants/(?P<token>[^/]+)", self.delete_tenant,
+          AUTH_ADMIN_TENANTS)
+        # device types + commands
+        r("GET", r"/api/devicetypes", self.list_device_types)
+        r("POST", r"/api/devicetypes", self.create_device_type)
+        r("GET", r"/api/devicetypes/(?P<token>[^/]+)", self.get_device_type)
+        r("POST", r"/api/devicetypes/(?P<token>[^/]+)/commands",
+          self.create_command)
+        r("GET", r"/api/devicetypes/(?P<token>[^/]+)/commands",
+          self.list_commands)
+        # devices
+        r("GET", r"/api/devices", self.list_devices)
+        r("POST", r"/api/devices", self.create_device)
+        r("GET", r"/api/devices/(?P<token>[^/]+)", self.get_device)
+        r("DELETE", r"/api/devices/(?P<token>[^/]+)", self.delete_device)
+        r("GET", r"/api/devices/(?P<token>[^/]+)/state", self.get_device_state)
+        # assignments + events
+        r("GET", r"/api/assignments", self.list_assignments)
+        r("POST", r"/api/assignments", self.create_assignment)
+        r("GET", r"/api/assignments/(?P<token>[^/]+)", self.get_assignment)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/end",
+          self.release_assignment)
+        r("GET", r"/api/assignments/(?P<token>[^/]+)/measurements",
+          self.list_measurements)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/measurements",
+          self.add_measurement)
+        r("GET", r"/api/assignments/(?P<token>[^/]+)/locations",
+          self.list_locations)
+        r("GET", r"/api/assignments/(?P<token>[^/]+)/alerts", self.list_alerts)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/invocations",
+          self.invoke_command)
+        # areas / customers / zones / assets
+        r("GET", r"/api/areas", self.list_areas)
+        r("POST", r"/api/areas", self.create_area)
+        r("GET", r"/api/customers", self.list_customers)
+        r("POST", r"/api/customers", self.create_customer)
+        r("GET", r"/api/zones", self.list_zones)
+        r("POST", r"/api/zones", self.create_zone)
+        r("GET", r"/api/assettypes", self.list_asset_types)
+        r("POST", r"/api/assettypes", self.create_asset_type)
+        r("GET", r"/api/assets", self.list_assets)
+        r("POST", r"/api/assets", self.create_asset)
+        # alerts (tenant-wide)
+        r("GET", r"/api/alerts", self.list_tenant_alerts)
+        # batch + training
+        r("POST", r"/api/batch/command", self.batch_command)
+        r("POST", r"/api/batch/train", self.batch_train)
+        r("GET", r"/api/batch/(?P<id>[^/]+)", self.get_batch)
+        r("GET", r"/api/batch/(?P<id>[^/]+)/elements", self.get_batch_elements)
+        # schedules
+        r("GET", r"/api/schedules", self.list_schedules)
+        r("POST", r"/api/schedules", self.create_schedule)
+        r("POST", r"/api/jobs", self.create_job)
+        # scripts (rule-processing extension surface)
+        r("GET", r"/api/scripts", self.list_scripts, AUTH_ADMIN_SCRIPTS)
+        r("PUT", r"/api/scripts/(?P<name>[^/]+)", self.put_script,
+          AUTH_ADMIN_SCRIPTS)
+        r("DELETE", r"/api/scripts/(?P<name>[^/]+)", self.delete_script,
+          AUTH_ADMIN_SCRIPTS)
+        # labels
+        r("GET", r"/api/labels/devices/(?P<token>[^/]+)", self.device_label)
+
+    # -- handlers: auth/instance -------------------------------------------
+
+    async def post_jwt(self, req: Request):
+        authz = req.headers.get("authorization", "")
+        if not authz.lower().startswith("basic "):
+            raise HttpError(401, "basic auth required")
+        try:
+            username, _, password = base64.b64decode(
+                authz[6:]).decode().partition(":")
+        except Exception as exc:  # noqa: BLE001
+            raise HttpError(400, "malformed basic auth") from exc
+        token = self._im().authenticate(username, password)
+        if token is None:
+            raise HttpError(401, "invalid credentials")
+        return {"token": token}
+
+    async def get_health(self, req: Request):
+        return self.runtime.health()
+
+    async def get_metrics(self, req: Request):
+        return self.runtime.metrics.snapshot()
+
+    async def get_topics(self, req: Request):
+        bus = self.runtime.bus
+        return {t: bus.end_offsets(t) for t in bus.topic_names()}
+
+    # -- handlers: users/tenants -------------------------------------------
+
+    async def list_users(self, req: Request):
+        return [entity_to_dict(u) for u in self._im().users.list_users()]
+
+    async def create_user(self, req: Request):
+        b = req.json()
+        try:
+            user = self._im().create_user(
+                b["username"], b["password"],
+                tuple(b.get("authorities", ["REST"])),
+                b.get("firstName", ""), b.get("lastName", ""))
+        except ValueError as exc:
+            raise HttpError(409, str(exc)) from exc
+        return entity_to_dict(user)
+
+    async def list_tenants(self, req: Request):
+        return [entity_to_dict(t) for t in self._im().list_tenants()]
+
+    async def create_tenant(self, req: Request):
+        b = req.json()
+        if "token" not in b:
+            raise HttpError(400, "token required")
+        try:
+            tenant = await self._im().create_tenant(
+                b["token"], b.get("name", ""), b.get("sections"),
+                tuple(b.get("authorizedUserIds", ())))
+        except ValueError as exc:
+            raise HttpError(409, str(exc)) from exc
+        return entity_to_dict(tenant)
+
+    async def get_tenant(self, req: Request):
+        tenant = self._im().get_tenant(req.params["token"])
+        if tenant is None:
+            raise HttpError(404, "unknown tenant")
+        return entity_to_dict(tenant)
+
+    async def update_tenant(self, req: Request):
+        b = req.json()
+        try:
+            tenant = await self._im().update_tenant(
+                req.params["token"], b.get("sections"), b.get("name"))
+        except KeyError as exc:
+            raise HttpError(404, str(exc)) from exc
+        return entity_to_dict(tenant)
+
+    async def delete_tenant(self, req: Request):
+        tenant = await self._im().delete_tenant(req.params["token"])
+        if tenant is None:
+            raise HttpError(404, "unknown tenant")
+        return entity_to_dict(tenant)
+
+    # -- handlers: device model --------------------------------------------
+
+    async def list_device_types(self, req: Request):
+        return [entity_to_dict(t) for t in self._dm(req).list_device_types(
+            page=req.int_qp("page", 1), page_size=req.int_qp("pageSize", 100))]
+
+    async def create_device_type(self, req: Request):
+        b = req.json()
+        dt = self._dm(req).create_device_type(DeviceType(
+            token=b.get("token", ""), name=b.get("name", ""),
+            description=b.get("description", ""),
+            channels=tuple(b.get("channels", ("value",)))))
+        return entity_to_dict(dt)
+
+    async def get_device_type(self, req: Request):
+        dt = self._dm(req).get_device_type_by_token(req.params["token"])
+        if dt is None:
+            raise HttpError(404, "unknown device type")
+        return entity_to_dict(dt)
+
+    async def create_command(self, req: Request):
+        dm = self._dm(req)
+        dt = dm.get_device_type_by_token(req.params["token"])
+        if dt is None:
+            raise HttpError(404, "unknown device type")
+        b = req.json()
+        cmd = dm.create_device_command(DeviceCommand(
+            token=b.get("token", ""), device_type_id=dt.id,
+            name=b.get("name", ""), namespace=b.get("namespace",
+                                                    "http://swx/default"),
+            parameters=tuple((p["name"], p.get("type", "string"),
+                              p.get("required", False))
+                             for p in b.get("parameters", []))))
+        return entity_to_dict(cmd)
+
+    async def list_commands(self, req: Request):
+        dm = self._dm(req)
+        dt = dm.get_device_type_by_token(req.params["token"])
+        if dt is None:
+            raise HttpError(404, "unknown device type")
+        return [entity_to_dict(c) for c in dm.list_device_commands(dt.id)]
+
+    async def list_devices(self, req: Request):
+        return [entity_to_dict(d) for d in self._dm(req).list_devices(
+            page=req.int_qp("page", 1), page_size=req.int_qp("pageSize", 100))]
+
+    async def create_device(self, req: Request):
+        dm = self._dm(req)
+        b = req.json()
+        dt = dm.get_device_type_by_token(b.get("deviceType", ""))
+        if dt is None:
+            raise HttpError(400, "deviceType token required and must exist")
+        try:
+            device = dm.create_device(Device(
+                token=b.get("token", ""), device_type_id=dt.id,
+                comments=b.get("comments", ""),
+                metadata=b.get("metadata", {})))
+        except ValueError as exc:
+            raise HttpError(409, str(exc)) from exc
+        if b.get("createAssignment", True):
+            dm.create_device_assignment(DeviceAssignment(
+                device_id=device.id, token=f"{device.token}-a"))
+        return entity_to_dict(device)
+
+    async def get_device(self, req: Request):
+        return entity_to_dict(self._device_by_token(req, req.params["token"]))
+
+    async def delete_device(self, req: Request):
+        device = self._device_by_token(req, req.params["token"])
+        return entity_to_dict(self._dm(req).delete_device(device.id))
+
+    async def get_device_state(self, req: Request):
+        device = self._device_by_token(req, req.params["token"])
+        engine = self._engine(req, "device-state")
+        return engine.get_state(device.index)
+
+    # -- handlers: assignments + events ------------------------------------
+
+    def _assignment(self, req: Request) -> DeviceAssignment:
+        a = self._dm(req).get_device_assignment_by_token(req.params["token"])
+        if a is None:
+            raise HttpError(404, "unknown assignment")
+        return a
+
+    async def list_assignments(self, req: Request):
+        return [entity_to_dict(a) for a in self._dm(req).list_device_assignments(
+            page=req.int_qp("page", 1), page_size=req.int_qp("pageSize", 100))]
+
+    async def create_assignment(self, req: Request):
+        dm = self._dm(req)
+        b = req.json()
+        device = dm.get_device_by_token(b.get("deviceToken", ""))
+        if device is None:
+            raise HttpError(400, "deviceToken required and must exist")
+        a = dm.create_device_assignment(DeviceAssignment(
+            token=b.get("token", ""), device_id=device.id,
+            customer_id=b.get("customerId"), area_id=b.get("areaId"),
+            asset_id=b.get("assetId")))
+        return entity_to_dict(a)
+
+    async def get_assignment(self, req: Request):
+        return entity_to_dict(self._assignment(req))
+
+    async def release_assignment(self, req: Request):
+        a = self._assignment(req)
+        return entity_to_dict(self._dm(req).release_device_assignment(a.id))
+
+    def _assignment_device_index(self, req: Request) -> int:
+        a = self._assignment(req)
+        device = self._dm(req).get_device(a.device_id)
+        if device is None:
+            raise HttpError(404, "assignment's device is gone")
+        return device.index
+
+    async def list_measurements(self, req: Request):
+        idx = self._assignment_device_index(req)
+        ms = self._em(req).list_measurements(
+            idx, mtype=req.int_qp("mtype", 0),
+            start=req.float_qp("start", 0.0),
+            end=req.float_qp("end", 1e18),
+            limit=req.int_qp("limit", 100))
+        return [event_to_dict(m) for m in ms]
+
+    async def add_measurement(self, req: Request):
+        """Cold-path single-event ingest (reference REST parity; bulk
+        telemetry uses the SWB1 gateway path)."""
+        from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+        import time as _time
+
+        idx = self._assignment_device_index(req)
+        b = req.json()
+        tenant_id = self._tenant_id(req)
+        batch = MeasurementBatch(
+            BatchContext(tenant_id=tenant_id, source="rest"),
+            np.asarray([idx], np.uint32),
+            np.asarray([b.get("mtype", 0)], np.uint16),
+            np.asarray([b.get("value", 0.0)], np.float32),
+            np.asarray([b.get("eventDate", _time.time())], np.float64))
+        sources = self._engine(req, "event-sources")
+        await self.runtime.bus.produce(
+            sources.tenant_topic("event-source-decoded-events"), batch,
+            key="rest")
+        return {"accepted": 1}
+
+    async def list_locations(self, req: Request):
+        idx = self._assignment_device_index(req)
+        return [event_to_dict(loc) for loc in self._em(req).list_locations(
+            idx, limit=req.int_qp("limit", 100))]
+
+    async def list_alerts(self, req: Request):
+        idx = self._assignment_device_index(req)
+        return [event_to_dict(a) for a in self._em(req).list_alerts(
+            idx, limit=req.int_qp("limit", 100))]
+
+    async def invoke_command(self, req: Request):
+        from sitewhere_tpu.domain.events import DeviceCommandInvocation
+
+        a = self._assignment(req)
+        dm = self._dm(req)
+        b = req.json()
+        command = None
+        if b.get("commandToken"):
+            command = dm.get_device_command_by_token(
+                a.device_type_id, b["commandToken"])
+            if command is None:
+                raise HttpError(400, "unknown commandToken")
+        inv = DeviceCommandInvocation(
+            device_id=a.device_id, assignment_id=a.id,
+            initiator="rest", initiator_id=req.auth.username if req.auth else "",
+            command_id=command.id if command else b.get("commandId", ""),
+            parameter_values=b.get("parameterValues", {}))
+        em = self._em(req)
+        await em.add_command_invocations([inv])
+        return event_to_dict(inv)
+
+    async def list_tenant_alerts(self, req: Request):
+        return [event_to_dict(a) for a in self._em(req).list_alerts(
+            limit=req.int_qp("limit", 100))]
+
+    # -- handlers: areas/customers/zones/assets ----------------------------
+
+    async def list_areas(self, req: Request):
+        return [entity_to_dict(a) for a in self._dm(req).list_areas()]
+
+    async def create_area(self, req: Request):
+        b = req.json()
+        return entity_to_dict(self._dm(req).create_area(Area(
+            token=b.get("token", ""), name=b.get("name", ""),
+            description=b.get("description", ""),
+            bounds=tuple(map(tuple, b.get("bounds", ()))))))
+
+    async def list_customers(self, req: Request):
+        return [entity_to_dict(c) for c in self._dm(req).list_customers()]
+
+    async def create_customer(self, req: Request):
+        b = req.json()
+        return entity_to_dict(self._dm(req).create_customer(Customer(
+            token=b.get("token", ""), name=b.get("name", ""))))
+
+    async def list_zones(self, req: Request):
+        return [entity_to_dict(z) for z in self._dm(req).list_zones()]
+
+    async def create_zone(self, req: Request):
+        b = req.json()
+        return entity_to_dict(self._dm(req).create_zone(Zone(
+            token=b.get("token", ""), area_id=b.get("areaId", ""),
+            name=b.get("name", ""),
+            bounds=tuple(map(tuple, b.get("bounds", ()))))))
+
+    def _am(self, req: Request):
+        return self.runtime.api("asset-management").management(
+            self._tenant_id(req))
+
+    async def list_asset_types(self, req: Request):
+        return [entity_to_dict(t) for t in self._am(req).list_asset_types()]
+
+    async def create_asset_type(self, req: Request):
+        b = req.json()
+        return entity_to_dict(self._am(req).create_asset_type(AssetType(
+            token=b.get("token", ""), name=b.get("name", ""),
+            asset_category=b.get("assetCategory", "hardware"))))
+
+    async def list_assets(self, req: Request):
+        return [entity_to_dict(a) for a in self._am(req).list_assets()]
+
+    async def create_asset(self, req: Request):
+        am = self._am(req)
+        b = req.json()
+        at = am.get_asset_type_by_token(b.get("assetType", ""))
+        return entity_to_dict(am.create_asset(Asset(
+            token=b.get("token", ""), name=b.get("name", ""),
+            asset_type_id=at.id if at else "")))
+
+    # -- handlers: batch/training ------------------------------------------
+
+    async def batch_command(self, req: Request):
+        b = req.json()
+        dm = self._dm(req)
+        ops = self._engine(req, "batch-operations")
+        device_ids = []
+        if b.get("deviceTokens"):
+            for t in b["deviceTokens"]:
+                d = dm.get_device_by_token(t)
+                if d is not None:
+                    device_ids.append(d.id)
+        elif b.get("groupToken"):
+            g = dm.get_device_group_by_token(b["groupToken"])
+            if g is not None:
+                device_ids = [d.id for d in dm.expand_group_devices(g.id)]
+        command = None
+        if b.get("commandToken"):
+            command = dm.find_device_command_by_token(b["commandToken"])
+            if command is None:
+                raise HttpError(400, f"unknown commandToken "
+                                     f"{b['commandToken']!r}")
+            # commands are scoped to a device type: drop mismatched targets
+            device_ids = [d for d in device_ids
+                          if dm.get_device(d).device_type_id
+                          == command.device_type_id]
+        if not device_ids:
+            raise HttpError(400, "no matching target devices")
+        op = await ops.submit_command_operation(
+            device_ids,
+            command.id if command else b.get("commandId", ""),
+            b.get("parameterValues", {}),
+            initiator="rest",
+            initiator_id=req.auth.username if req.auth else "")
+        return entity_to_dict(op)
+
+    async def batch_train(self, req: Request):
+        b = req.json()
+        ops = self._engine(req, "batch-operations")
+        op = await ops.submit_training_operation(
+            b.get("model"), steps=b.get("steps", 200),
+            batch_size=b.get("batchSize", 1024),
+            learning_rate=b.get("learningRate", 1e-3),
+            window=b.get("window"), mtype=b.get("mtype", 0))
+        return entity_to_dict(op)
+
+    async def get_batch(self, req: Request):
+        ops = self._engine(req, "batch-operations")
+        op = ops.get_operation(req.params["id"])
+        if op is None:
+            raise HttpError(404, "unknown batch operation")
+        return entity_to_dict(op)
+
+    async def get_batch_elements(self, req: Request):
+        ops = self._engine(req, "batch-operations")
+        return [entity_to_dict(e)
+                for e in ops.list_batch_elements(req.params["id"])]
+
+    # -- handlers: schedules -----------------------------------------------
+
+    async def list_schedules(self, req: Request):
+        sched = self._engine(req, "schedule-management")
+        return [entity_to_dict(s) for s in sched.list_schedules()]
+
+    async def create_schedule(self, req: Request):
+        sched = self._engine(req, "schedule-management")
+        b = req.json()
+        return entity_to_dict(sched.create_schedule(Schedule(
+            token=b.get("token", ""), name=b.get("name", ""),
+            trigger_type=b.get("triggerType", "simple"),
+            trigger_configuration=b.get("triggerConfiguration", {}),
+            start_date=b.get("startDate"), end_date=b.get("endDate"))))
+
+    async def create_job(self, req: Request):
+        sched = self._engine(req, "schedule-management")
+        b = req.json()
+        schedule = sched.get_schedule_by_token(b.get("scheduleToken", "")) \
+            or sched.get_schedule(b.get("scheduleId", ""))
+        if schedule is None:
+            raise HttpError(400, "scheduleToken/scheduleId must exist")
+        return entity_to_dict(sched.create_scheduled_job(ScheduledJob(
+            schedule_id=schedule.id, job_type=b.get("jobType",
+                                                    "command-invocation"),
+            configuration=b.get("configuration", {}))))
+
+    # -- handlers: scripts --------------------------------------------------
+
+    async def list_scripts(self, req: Request):
+        engine = self._engine(req, "rule-processing")
+        return [{"name": s.name, "version": s.version,
+                 "updatedAt": s.updated_at} for s in engine.scripts.list()]
+
+    async def put_script(self, req: Request):
+        engine = self._engine(req, "rule-processing")
+        b = req.json()
+        if "source" not in b:
+            raise HttpError(400, "source required")
+        try:
+            script = engine.put_script(req.params["name"], b["source"])
+        except Exception as exc:  # noqa: BLE001 - module body runs at upload;
+            # any exception there is the uploader's bug, not a server error
+            raise HttpError(400, f"script error: {type(exc).__name__}: "
+                                 f"{exc}") from exc
+        return {"name": script.name, "version": script.version}
+
+    async def delete_script(self, req: Request):
+        engine = self._engine(req, "rule-processing")
+        engine.delete_script(req.params["name"])
+        return {"deleted": req.params["name"]}
+
+    # -- handlers: labels ---------------------------------------------------
+
+    async def device_label(self, req: Request):
+        labels = self._engine(req, "label-generation")
+        try:
+            svg = labels.device_label(req.params["token"],
+                                      generator=req.qp("generator"))
+        except KeyError as exc:
+            raise HttpError(404, str(exc)) from exc
+        return ("image/svg+xml", svg)
+
+
+def _reason(status: int) -> str:
+    return {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+            403: "Forbidden", 404: "Not Found", 409: "Conflict",
+            413: "Payload Too Large", 500: "Internal Server Error",
+            503: "Service Unavailable"}.get(status, "Unknown")
+
+
+def _dumps(obj: Any) -> bytes:
+    return json.dumps(obj, default=_json_default).encode()
+
+
+def _json_default(o):
+    import enum
+
+    if isinstance(o, enum.Enum):
+        return o.value
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)

@@ -19,8 +19,10 @@ from sitewhere_tpu.services.outbound_connectors import OutboundConnectorsService
 from sitewhere_tpu.services.batch_operations import BatchOperationsService
 from sitewhere_tpu.services.schedule_management import ScheduleManagementService
 from sitewhere_tpu.services.label_generation import LabelGenerationService
+from sitewhere_tpu.services.instance_management import InstanceManagementService
 
 ALL_SERVICES = [
+    "InstanceManagementService",
     "DeviceManagementService",
     "AssetManagementService",
     "EventManagementService",

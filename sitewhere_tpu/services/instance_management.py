@@ -1,0 +1,128 @@
+"""instance-management service (reference: service-instance-management,
+[SURVEY.md §2.2]): instance bootstrap, user management, tenant
+management, JWT auth — and the host of the REST facade (rest/api.py).
+
+Global (not multitenant): users and tenants are instance-scoped, exactly
+as in the reference. Tenant CRUD drives the runtime's tenant-model-update
+broadcast so every service's engine manager reacts [SURVEY.md §3.5].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from sitewhere_tpu.config import TenantConfig
+from sitewhere_tpu.domain.model import Tenant, User, new_id
+from sitewhere_tpu.kernel.security import (
+    ALL_AUTHORITIES,
+    AuthContext,
+    TokenManagement,
+)
+from sitewhere_tpu.kernel.service import Service
+from sitewhere_tpu.persistence.memory import (
+    InMemoryTenantManagement,
+    InMemoryUserManagement,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class InstanceManagementService(Service):
+    identifier = "instance-management"
+    multitenant = False
+
+    def __init__(self, runtime, *, serve_rest: bool = True):
+        super().__init__(runtime)
+        self.users = InMemoryUserManagement()
+        self.tenant_store = InMemoryTenantManagement()
+        self.tokens = TokenManagement(
+            runtime.settings.jwt_secret,
+            expiration_s=runtime.settings.jwt_expiration_s)
+        self._bootstrap_admin = ("admin", "password")  # overridable pre-start
+        self.rest = None
+        if serve_rest:
+            from sitewhere_tpu.rest.api import RestServer
+
+            self.rest = RestServer(runtime)
+            self.add_child(self.rest)
+
+    async def _do_initialize(self, monitor) -> None:
+        # instance bootstrap (reference: instance templates seed an admin)
+        username, password = self._bootstrap_admin
+        if self.users.get_user_by_username(username) is None:
+            self.users.create_user(
+                User(username=username, first_name="Admin",
+                     authorities=ALL_AUTHORITIES), password)
+
+    # -- auth --------------------------------------------------------------
+
+    def authenticate(self, username: str, password: str) -> Optional[str]:
+        """Returns a JWT, or None."""
+        user = self.users.authenticate(username, password)
+        if user is None:
+            return None
+        return self.tokens.issue(user.username, user.authorities)
+
+    def validate(self, token: str) -> Optional[AuthContext]:
+        return self.tokens.validate(token)
+
+    # -- users -------------------------------------------------------------
+
+    def create_user(self, username: str, password: str,
+                    authorities: tuple[str, ...] = ("REST",),
+                    first_name: str = "", last_name: str = "") -> User:
+        if self.users.get_user_by_username(username) is not None:
+            raise ValueError(f"user {username!r} exists")
+        return self.users.create_user(
+            User(username=username, authorities=tuple(authorities),
+                 first_name=first_name, last_name=last_name), password)
+
+    # -- tenants -----------------------------------------------------------
+
+    async def create_tenant(self, tenant_id: str, name: str = "",
+                            sections: Optional[dict] = None,
+                            authorized_user_ids: tuple[str, ...] = ()) -> Tenant:
+        if self.tenant_store.get_tenant_by_token(tenant_id) is not None:
+            raise ValueError(f"tenant {tenant_id!r} exists")
+        tenant = self.tenant_store.create_tenant(Tenant(
+            token=tenant_id, name=name or tenant_id,
+            auth_token=new_id(),
+            authorized_user_ids=tuple(authorized_user_ids)))
+        await self.runtime.add_tenant(TenantConfig(
+            tenant_id=tenant_id, name=tenant.name,
+            authorized_user_ids=tuple(authorized_user_ids),
+            sections=sections or {}))
+        return tenant
+
+    async def update_tenant(self, tenant_id: str,
+                            sections: Optional[dict] = None,
+                            name: Optional[str] = None) -> Tenant:
+        tenant = self.tenant_store.get_tenant_by_token(tenant_id)
+        if tenant is None:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        if name is not None:
+            tenant = self.tenant_store.update_tenant(
+                dataclasses.replace(tenant, name=name))
+        current = self.runtime.tenants.get(tenant_id)
+        cfg = TenantConfig(
+            tenant_id=tenant_id, name=tenant.name,
+            authorized_user_ids=tenant.authorized_user_ids,
+            sections=sections if sections is not None
+            else (current.sections if current else {}))
+        await self.runtime.update_tenant(cfg)
+        return tenant
+
+    async def delete_tenant(self, tenant_id: str) -> Optional[Tenant]:
+        tenant = self.tenant_store.get_tenant_by_token(tenant_id)
+        if tenant is None:
+            return None
+        await self.runtime.remove_tenant(tenant_id)
+        return self.tenant_store.delete_tenant(tenant.id)
+
+    def list_tenants(self) -> list[Tenant]:
+        return self.tenant_store.list_tenants()
+
+    def get_tenant(self, tenant_id: str) -> Optional[Tenant]:
+        return self.tenant_store.get_tenant_by_token(tenant_id)

@@ -84,6 +84,13 @@ class RuleProcessingEngine(TenantEngine):
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.session: Optional[ScoringSession] = None
         self.hooks: dict[str, Hook] = {}
+        # script manager: uploaded python scripts become hooks (reference:
+        # Groovy stream processors synced per tenant, SURVEY.md §2.1)
+        from sitewhere_tpu.kernel.scripting import ScriptManager
+
+        self.scripts = ScriptManager(self.tenant_id)
+        for name, source in cfg.get("scripts", {}).items():
+            self.put_script(name, source)
         self.processor = RuleProcessor(self)
         self.add_child(self.processor)
 
@@ -119,6 +126,16 @@ class RuleProcessingEngine(TenantEngine):
     def remove_hook(self, name: str) -> None:
         self.hooks.pop(name, None)
 
+    def put_script(self, name: str, source: str):
+        """Upload/update a script; it hot-reloads into the hook slot."""
+        script = self.scripts.put(name, source)
+        self.hooks[f"script:{name}"] = self.scripts.hook(name)
+        return script
+
+    def delete_script(self, name: str) -> None:
+        self.scripts.delete(name)
+        self.hooks.pop(f"script:{name}", None)
+
     def swap_model_params(self, params: dict) -> int:
         """Hot-swap scoring params (called on checkpoint rollout)."""
         if self.session is None:
@@ -153,7 +170,8 @@ class RuleProcessor(BackgroundTaskComponent):
                     value = record.value
                     if session is not None and isinstance(value, MeasurementBatch):
                         session.admit(value)
-                    for name, hook in engine.hooks.items():
+                    # snapshot: uploads may mutate hooks mid-await
+                    for name, hook in list(engine.hooks.items()):
                         try:
                             await hook(value, api)
                         except Exception:  # noqa: BLE001 - hook errors isolated

@@ -1,0 +1,295 @@
+"""REST facade + JWT + script-manager tests [SURVEY.md §1 L7, §2.1].
+
+Uses a raw asyncio HTTP client against the real listening socket — the
+same surface an external SiteWhere client uses.
+"""
+
+import asyncio
+import base64
+import contextlib
+import json
+
+from sitewhere_tpu.config import InstanceSettings
+from sitewhere_tpu.kernel.security import TokenManagement
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.services import (
+    AssetManagementService,
+    BatchOperationsService,
+    CommandDeliveryService,
+    DeviceManagementService,
+    DeviceRegistrationService,
+    DeviceStateService,
+    EventManagementService,
+    EventSourcesService,
+    InboundProcessingService,
+    InstanceManagementService,
+    LabelGenerationService,
+    OutboundConnectorsService,
+    RuleProcessingService,
+    ScheduleManagementService,
+)
+
+from tests.test_pipeline import wait_until
+
+
+async def http(port, method, path, *, token=None, body=None, basic=None,
+               tenant=None, raw=False):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    headers = [f"{method} {path} HTTP/1.1", "Host: localhost",
+               f"Content-Length: {len(payload)}"]
+    if token:
+        headers.append(f"Authorization: Bearer {token}")
+    if basic:
+        headers.append("Authorization: Basic "
+                       + base64.b64encode(basic.encode()).decode())
+    if tenant:
+        headers.append(f"X-SiteWhere-Tenant: {tenant}")
+    writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + payload)
+    await writer.drain()
+    status_line = await reader.readline()
+    status = int(status_line.split()[1])
+    resp_headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        resp_headers[k.strip().lower()] = v.strip()
+    length = int(resp_headers.get("content-length", 0))
+    data = await reader.readexactly(length) if length else b""
+    writer.close()
+    if raw:
+        return status, resp_headers, data
+    return status, (json.loads(data) if data else None)
+
+
+@contextlib.asynccontextmanager
+async def rest_instance():
+    rt = ServiceRuntime(InstanceSettings(instance_id="rest", rest_port=0))
+    for cls in (InstanceManagementService, DeviceManagementService,
+                AssetManagementService, EventSourcesService,
+                InboundProcessingService, EventManagementService,
+                DeviceStateService, RuleProcessingService,
+                DeviceRegistrationService, CommandDeliveryService,
+                OutboundConnectorsService, BatchOperationsService,
+                ScheduleManagementService, LabelGenerationService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    port = rt.services["instance-management"].rest.port
+    try:
+        yield rt, port
+    finally:
+        await rt.stop()
+
+
+def test_jwt_roundtrip_and_authz(run):
+    async def main():
+        async with rest_instance() as (rt, port):
+            # no auth → 401
+            status, body = await http(port, "GET", "/api/tenants")
+            assert status == 401
+            # bad credentials → 401
+            status, _ = await http(port, "POST", "/api/jwt",
+                                   basic="admin:wrong")
+            assert status == 401
+            # good credentials → token
+            status, body = await http(port, "POST", "/api/jwt",
+                                      basic="admin:password")
+            assert status == 200
+            token = body["token"]
+            # token works
+            status, body = await http(port, "GET", "/api/tenants", token=token)
+            assert status == 200 and body == []
+            # health requires no auth (k8s-liveness parity)
+            status, body = await http(port, "GET", "/api/instance/health")
+            assert status == 200 and body["status"] == "started"
+            # tampered token → 401
+            status, _ = await http(port, "GET", "/api/tenants",
+                                   token=token[:-4] + "AAAA")
+            assert status == 401
+
+    run(main())
+
+
+def test_jwt_expiry():
+    tm = TokenManagement("secret", expiration_s=3600)
+    t = tm.issue("u", ("REST",), expiration_s=-10)
+    assert tm.validate(t) is None
+    t2 = tm.issue("u", ("REST",))
+    ctx = tm.validate(t2)
+    assert ctx.username == "u" and ctx.has_authority("REST")
+    assert TokenManagement("other").validate(t2) is None
+
+
+def test_full_rest_device_lifecycle(run):
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+
+            # create tenant (engines spin across services)
+            status, tenant = await http(
+                port, "POST", "/api/tenants", token=tok,
+                body={"token": "acme", "name": "Acme",
+                      "sections": {"rule-processing": {"model": None}}})
+            assert status == 200 and tenant["token"] == "acme"
+            # duplicate → 409
+            status, _ = await http(port, "POST", "/api/tenants", token=tok,
+                                   body={"token": "acme"})
+            assert status == 409
+
+            # device type + command + device
+            status, dt = await http(
+                port, "POST", "/api/devicetypes", token=tok, tenant="acme",
+                body={"token": "thermo", "name": "Thermometer"})
+            assert status == 200
+            status, cmd = await http(
+                port, "POST", "/api/devicetypes/thermo/commands", token=tok,
+                tenant="acme", body={"token": "reboot", "name": "reboot"})
+            assert status == 200
+            status, device = await http(
+                port, "POST", "/api/devices", token=tok, tenant="acme",
+                body={"token": "dev-1", "deviceType": "thermo"})
+            assert status == 200 and device["index"] == 0
+
+            # ingest one measurement via REST → flows the whole pipeline
+            status, r = await http(
+                port, "POST", "/api/assignments/dev-1-a/measurements",
+                token=tok, tenant="acme",
+                body={"value": 21.5, "eventDate": 1000.0})
+            assert status == 200 and r["accepted"] == 1
+
+            async def measurement_visible():
+                s, ms = await http(
+                    port, "GET", "/api/assignments/dev-1-a/measurements",
+                    token=tok, tenant="acme")
+                return s == 200 and len(ms) == 1 and ms[0]["value"] == 21.5
+
+            for _ in range(100):
+                if await measurement_visible():
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("measurement never visible")
+
+            # device state materialized
+            status, st = await http(port, "GET", "/api/devices/dev-1/state",
+                                    token=tok, tenant="acme")
+            assert status == 200 and st["last_seen"] == 1000.0
+
+            # command invocation → delivery
+            status, inv = await http(
+                port, "POST", "/api/assignments/dev-1-a/invocations",
+                token=tok, tenant="acme",
+                body={"commandToken": "reboot",
+                      "parameterValues": {"delay": 1}})
+            assert status == 200
+            delivery = rt.api("command-delivery").delivery("acme")
+            await wait_until(
+                lambda: delivery.providers["queue"].inbox("dev-1"))
+
+            # label renders as SVG
+            status, headers, svg = await http(
+                port, "GET", "/api/labels/devices/dev-1", token=tok,
+                tenant="acme", raw=True)
+            assert status == 200
+            assert headers["content-type"] == "image/svg+xml"
+            assert svg.startswith(b"<svg")
+
+            # unknown tenant → 404; missing header → 400
+            status, _ = await http(port, "GET", "/api/devices", token=tok,
+                                   tenant="ghost")
+            assert status == 404
+            status, _ = await http(port, "GET", "/api/devices", token=tok)
+            assert status == 400
+
+    run(main())
+
+
+def test_rest_script_upload_hot_reload(run):
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme",
+                             "sections": {"rule-processing": {"model": None}}})
+            # syntax error rejected at upload
+            status, err = await http(
+                port, "PUT", "/api/scripts/bad", token=tok, tenant="acme",
+                body={"source": "def process(:"})
+            assert status == 400
+            # non-async rejected
+            status, _ = await http(
+                port, "PUT", "/api/scripts/sync", token=tok, tenant="acme",
+                body={"source": "def process(event, api):\n    pass"})
+            assert status == 400
+            # good script installs as a hook
+            src = ("counted = []\n"
+                   "async def process(event, api):\n"
+                   "    counted.append(type(event).__name__)\n")
+            status, s1 = await http(
+                port, "PUT", "/api/scripts/counter", token=tok, tenant="acme",
+                body={"source": src})
+            assert status == 200 and s1["version"] == 1
+            engine = rt.api("rule-processing").engine("acme")
+            assert "script:counter" in engine.hooks
+            # update → version bumps, hook replaced
+            status, s2 = await http(
+                port, "PUT", "/api/scripts/counter", token=tok, tenant="acme",
+                body={"source": src + "# v2\n"})
+            assert s2["version"] == 2
+            # list + delete
+            status, scripts = await http(port, "GET", "/api/scripts",
+                                         token=tok, tenant="acme")
+            assert [s["name"] for s in scripts] == ["counter"]
+            await http(port, "DELETE", "/api/scripts/counter", token=tok,
+                       tenant="acme")
+            assert "script:counter" not in engine.hooks
+
+    run(main())
+
+
+def test_rest_batch_and_training(run):
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme",
+                             "sections": {"rule-processing": {"model": None}}})
+            await http(port, "POST", "/api/devicetypes", token=tok,
+                       tenant="acme", body={"token": "t", "name": "T"})
+            await http(port, "POST", "/api/devicetypes/t/commands", token=tok,
+                       tenant="acme", body={"token": "ping", "name": "ping"})
+            for i in range(3):
+                await http(port, "POST", "/api/devices", token=tok,
+                           tenant="acme",
+                           body={"token": f"d{i}", "deviceType": "t"})
+            status, op = await http(
+                port, "POST", "/api/batch/command", token=tok, tenant="acme",
+                body={"deviceTokens": ["d0", "d1", "d2"],
+                      "commandToken": "ping", "deviceTypeId": ""})
+            assert status == 200
+
+            async def done():
+                s, o = await http(port, "GET", f"/api/batch/{op['id']}",
+                                  token=tok, tenant="acme")
+                return o["processing_status"] == "finished"
+
+            for _ in range(200):
+                if await done():
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("batch op never finished")
+            status, elements = await http(
+                port, "GET", f"/api/batch/{op['id']}/elements", token=tok,
+                tenant="acme")
+            assert len(elements) == 3
+
+    run(main())
