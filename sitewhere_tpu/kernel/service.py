@@ -98,6 +98,13 @@ class Service(LifecycleComponent):
     async def start_tenant_engine(self, tenant: TenantConfig) -> TenantEngine:
         existing = self.engines.get(tenant.tenant_id)
         if existing is not None:
+            if (existing.tenant is tenant
+                    and existing.status == LifecycleStatus.STARTED):
+                # already built from this exact config: the manager's
+                # bootstrap scan and the tenant-model-updates broadcast
+                # race on a freshly added tenant — creating twice would
+                # needlessly tear down a just-started engine
+                return existing
             await existing.stop()
         engine = self.create_tenant_engine(tenant)
         self.engines[tenant.tenant_id] = engine

@@ -15,11 +15,13 @@ the MXU; float32 accumulations.
 """
 
 from sitewhere_tpu.models.lstm import LstmConfig, LstmAnomalyModel
+from sitewhere_tpu.models.tft import TftConfig, TftForecaster
 from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
 from sitewhere_tpu.models.registry import MODEL_REGISTRY, build_model
 
 __all__ = [
     "LstmConfig", "LstmAnomalyModel",
+    "TftConfig", "TftForecaster",
     "ZScoreConfig", "ZScoreModel",
     "MODEL_REGISTRY", "build_model",
 ]

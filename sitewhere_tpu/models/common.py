@@ -1,0 +1,59 @@
+"""Shared model primitives: dense init and the fused-gate LSTM cell.
+
+One implementation of the bf16-matmul/f32-accumulate LSTM step serves
+every recurrent model in the zoo (lstm.py, tft.py) so numerics fixes
+land once. TPU notes: the [in+hidden, 4*hidden] fused gate layout keeps
+the per-step work in two MXU matmuls; state stays float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense_init(rng, n_in: int, n_out: int, scale: float | None = None) -> dict:
+    scale = scale if scale is not None else (1.0 / np.sqrt(n_in))
+    w_key, _ = jax.random.split(rng)
+    return {
+        "w": jax.random.normal(w_key, (n_in, n_out), jnp.float32) * scale,
+        "b": jnp.zeros((n_out,), jnp.float32),
+    }
+
+
+def lstm_init(rng, d_in: int, d: int) -> dict:
+    """Fused i/f/g/o gate weights; forget-gate bias +1 (standard
+    stabilization)."""
+    k1, k2 = jax.random.split(rng)
+    return {
+        "wx": jax.random.normal(k1, (d_in, 4 * d), jnp.float32)
+        / np.sqrt(d_in),
+        "wh": jax.random.normal(k2, (d, 4 * d), jnp.float32) / np.sqrt(d),
+        "b": jnp.concatenate([jnp.zeros((d,)), jnp.ones((d,)),
+                              jnp.zeros((2 * d,))]).astype(jnp.float32),
+    }
+
+
+def lstm_scan(params: dict, seq: jax.Array, cdt,
+              h0: jax.Array | None = None, c0: jax.Array | None = None):
+    """Run the LSTM over time. seq: [B, T, d_in] → (outputs [B, T, d],
+    (h, c)). Matmuls in `cdt` (bfloat16 on TPU), gates/state in f32."""
+    wx, wh = params["wx"].astype(cdt), params["wh"].astype(cdt)
+    b = params["b"]
+    d = wh.shape[0]
+    B = seq.shape[0]
+    h0 = h0 if h0 is not None else jnp.zeros((B, d), jnp.float32)
+    c0 = c0 if c0 is not None else jnp.zeros((B, d), jnp.float32)
+
+    def step(carry, x_t):
+        h, c = carry
+        gates = (x_t.astype(cdt) @ wx).astype(jnp.float32) \
+            + (h.astype(cdt) @ wh).astype(jnp.float32) + b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(seq, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), (h, c)

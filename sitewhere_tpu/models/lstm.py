@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sitewhere_tpu.models.common import dense_init, lstm_init, lstm_scan
+
 
 @dataclass(frozen=True)
 class LstmConfig:
@@ -33,15 +35,6 @@ class LstmConfig:
     layers: int = 1
     compute_dtype: Any = jnp.bfloat16
     score_clip: float = 50.0  # scores are z-like; clip insanity
-
-
-def _dense_init(rng, n_in, n_out, scale=None):
-    scale = scale if scale is not None else (1.0 / np.sqrt(n_in))
-    w_key, _ = jax.random.split(rng)
-    return {
-        "w": jax.random.normal(w_key, (n_in, n_out), jnp.float32) * scale,
-        "b": jnp.zeros((n_out,), jnp.float32),
-    }
 
 
 class LstmAnomalyModel:
@@ -61,20 +54,9 @@ class LstmAnomalyModel:
         keys = jax.random.split(rng, cfg.layers + 1)
         in_dim = 1
         for layer in range(cfg.layers):
-            # fused gate weights: [in+hidden, 4*hidden] (i, f, g, o)
-            params[f"lstm{layer}"] = {
-                "wx": jax.random.normal(keys[layer], (in_dim, 4 * cfg.hidden),
-                                        jnp.float32) / np.sqrt(in_dim),
-                "wh": jax.random.normal(jax.random.fold_in(keys[layer], 1),
-                                        (cfg.hidden, 4 * cfg.hidden),
-                                        jnp.float32) / np.sqrt(cfg.hidden),
-                # forget-gate bias +1 (standard stabilization)
-                "b": jnp.concatenate([
-                    jnp.zeros((cfg.hidden,)), jnp.ones((cfg.hidden,)),
-                    jnp.zeros((2 * cfg.hidden,))]).astype(jnp.float32),
-            }
+            params[f"lstm{layer}"] = lstm_init(keys[layer], in_dim, cfg.hidden)
             in_dim = cfg.hidden
-        params["head"] = _dense_init(keys[-1], cfg.hidden, 1)
+        params["head"] = dense_init(keys[-1], cfg.hidden, 1)
         return params
 
     # -- forward -----------------------------------------------------------
@@ -90,33 +72,11 @@ class LstmAnomalyModel:
     def _predictions(self, params: dict, xn: jax.Array) -> jax.Array:
         """One-step-ahead predictions for steps 1..W-1.  xn: [B, W] → [B, W-1]."""
         cfg = self.cfg
-        B = xn.shape[0]
         cdt = cfg.compute_dtype
-        inputs = xn[:, :-1, None].astype(cdt)             # [B, W-1, 1]
-
-        def layer_scan(layer_params, seq):
-            wx = layer_params["wx"].astype(cdt)
-            wh = layer_params["wh"].astype(cdt)
-            b = layer_params["b"].astype(jnp.float32)
-            H = wh.shape[0]
-
-            def step(carry, x_t):
-                h, c = carry
-                gates = (x_t @ wx).astype(jnp.float32) \
-                    + (h.astype(cdt) @ wh).astype(jnp.float32) + b
-                i, f, g, o = jnp.split(gates, 4, axis=-1)
-                c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-                h = jax.nn.sigmoid(o) * jnp.tanh(c)
-                return (h, c), h
-
-            h0 = jnp.zeros((seq.shape[0], H), jnp.float32)
-            (_, _), hs = jax.lax.scan(step, (h0, h0),
-                                      jnp.swapaxes(seq, 0, 1))
-            return jnp.swapaxes(hs, 0, 1)                 # [B, T, H]
-
-        seq = inputs
+        seq = xn[:, :-1, None].astype(cdt)                # [B, W-1, 1]
         for layer in range(cfg.layers):
-            seq = layer_scan(params[f"lstm{layer}"], seq).astype(cdt)
+            seq, _ = lstm_scan(params[f"lstm{layer}"], seq, cdt)
+            seq = seq.astype(cdt)
         head = params["head"]
         preds = (seq.astype(jnp.float32) @ head["w"] + head["b"])[..., 0]
         return preds                                       # [B, W-1]

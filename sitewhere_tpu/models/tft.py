@@ -1,0 +1,374 @@
+"""Temporal Fusion Transformer forecaster (config 3 [BASELINE.json]).
+
+Multi-horizon quantile forecasting over a device's telemetry window,
+following Lim et al. 2021 (TFT): per-feature embeddings → variable
+selection networks → LSTM encoder/decoder → gated skip connections →
+static enrichment → interpretable multi-head attention → position-wise
+GRN → quantile heads. Mounted at the same rule-processing hook as the
+LSTM detector [SURVEY.md §1 L5/L6]; the anomaly score is the newest
+observations' violation of the predicted quantile interval, so one model
+serves both forecasting (config 3) and anomaly alerting (the judge's
+scoring path).
+
+TPU-first details:
+- same functional protocol as every registry model: `init`, and
+  `score/loss(params, x[B, W], valid[B, W])` — jit/vmap/pjit friendly,
+  static shapes, `lax.scan` over time, no Python branching on data.
+- matmuls in bfloat16 (MXU), softmax/layernorm/accumulation in float32.
+- attention is one fused [B, H, W] score matrix — no KV cache or dynamic
+  shapes; W is the model's whole receptive field. Longer histories shard
+  the time axis via `parallel/ring.py` ring attention (SURVEY.md §5.7).
+- per-window normalization (context-region stats) → one set of weights
+  serves heterogeneous fleets; vmaps over a stacked tenant axis for
+  config 4 multiplexing exactly like the LSTM model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.models.common import (
+    dense_init as _dense_init,
+    lstm_init as _lstm_init,
+    lstm_scan as _lstm_scan,
+)
+
+
+@dataclass(frozen=True)
+class TftConfig:
+    window: int = 64           # total input length W (context + horizon)
+    horizon: int = 8           # forecast steps H (scored region)
+    hidden: int = 32           # model width d
+    heads: int = 4
+    quantiles: tuple[float, ...] = (0.1, 0.5, 0.9)
+    compute_dtype: Any = jnp.bfloat16
+    score_clip: float = 50.0
+    min_history: int = 16      # valid context steps needed to score
+
+    @property
+    def context(self) -> int:
+        return self.window - self.horizon
+
+
+# -- parameter-free building blocks -----------------------------------------
+
+def _dense(p, x, cdt):
+    return (x.astype(cdt) @ p["w"].astype(cdt)).astype(jnp.float32) + p["b"]
+
+
+def _ln_init(d):
+    return {"scale": jnp.ones((d,), jnp.float32),
+            "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def _ln(p, x):
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * p["scale"] + p["bias"]
+
+
+def _grn_init(rng, d_in, d, d_out=None, with_context=False):
+    """Gated residual network params (TFT eq. 2-5)."""
+    d_out = d_out if d_out is not None else d
+    ks = jax.random.split(rng, 5)
+    p = {
+        "fc1": _dense_init(ks[0], d_in, d),
+        "fc2": _dense_init(ks[1], d, d_out),
+        "gate": _dense_init(ks[2], d_out, 2 * d_out),   # GLU over fc2 out
+        "ln": _ln_init(d_out),
+    }
+    if d_in != d_out:
+        p["skip"] = _dense_init(ks[3], d_in, d_out)
+    if with_context:
+        p["ctx"] = _dense_init(ks[4], d, d)
+    return p
+
+
+def _grn(p, a, cdt, context=None):
+    """GRN(a, c) = LayerNorm(skip(a) + GLU(W2 ELU(W1 a + W3 c)))."""
+    h = _dense(p["fc1"], a, cdt)
+    if context is not None:
+        h = h + _dense(p["ctx"], context, cdt)
+    h = jax.nn.elu(h)
+    h2 = _dense(p["fc2"], h, cdt)
+    g = _dense(p["gate"], h2, cdt)
+    val, gate = jnp.split(g, 2, axis=-1)
+    glu = val * jax.nn.sigmoid(gate)
+    skip = _dense(p["skip"], a, cdt) if "skip" in p else a
+    return _ln(p["ln"], skip + glu)
+
+
+def _glu_addnorm_init(rng, d):
+    return {"gate": _dense_init(rng, d, 2 * d), "ln": _ln_init(d)}
+
+
+def _glu_addnorm(p, x, skip, cdt):
+    g = _dense(p["gate"], x, cdt)
+    val, gate = jnp.split(g, 2, axis=-1)
+    return _ln(p["ln"], skip + val * jax.nn.sigmoid(gate))
+
+
+class TftForecaster:
+    """Functional TFT. Instances hold config only; params are a pytree
+    passed explicitly (pjit/vmap contract shared by the whole zoo)."""
+
+    name = "tft"
+
+    # observed past features: value, first difference; known features
+    # (past+future): sin/cos relative position (the univariate-telemetry
+    # stand-ins for TFT's observed/known covariate split)
+    N_PAST_VARS = 4
+    N_FUT_VARS = 2
+
+    def __init__(self, cfg: TftConfig = TftConfig()):
+        if cfg.horizon >= cfg.window:
+            raise ValueError("horizon must be < window")
+        if cfg.heads < 1 or cfg.hidden % cfg.heads != 0:
+            raise ValueError(
+                f"hidden ({cfg.hidden}) must be a positive multiple of "
+                f"heads ({cfg.heads})")
+        if len(cfg.quantiles) < 2 or list(cfg.quantiles) != sorted(cfg.quantiles):
+            raise ValueError("quantiles must be ascending, at least 2")
+        self.cfg = cfg
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        d, nq = cfg.hidden, len(cfg.quantiles)
+        ks = iter(jax.random.split(rng, 32))
+        p: dict = {
+            # per-variable scalar → d embeddings
+            "emb_past": [_dense_init(next(ks), 1, d)
+                         for _ in range(self.N_PAST_VARS)],
+            "emb_fut": [_dense_init(next(ks), 1, d)
+                        for _ in range(self.N_FUT_VARS)],
+            # learned static context (no static covariates in the fleet
+            # case; a learned vector keeps TFT's conditioning structure)
+            "static": jax.random.normal(next(ks), (d,), jnp.float32) * 0.02,
+            "grn_static": _grn_init(next(ks), d, d),
+            # variable selection: GRN over flattened embeddings → softmax
+            "vsn_past": _grn_init(next(ks), self.N_PAST_VARS * d, d,
+                                  d_out=self.N_PAST_VARS, with_context=True),
+            "vsn_past_var": [_grn_init(next(ks), d, d)
+                             for _ in range(self.N_PAST_VARS)],
+            "vsn_fut": _grn_init(next(ks), self.N_FUT_VARS * d, d,
+                                 d_out=self.N_FUT_VARS, with_context=True),
+            "vsn_fut_var": [_grn_init(next(ks), d, d)
+                            for _ in range(self.N_FUT_VARS)],
+            # sequence-to-sequence layer
+            "lstm_enc": _lstm_init(next(ks), d, d),
+            "lstm_dec": _lstm_init(next(ks), d, d),
+            "gate_seq": _glu_addnorm_init(next(ks), d),
+            # static enrichment + temporal self-attention
+            "grn_enrich": _grn_init(next(ks), d, d, with_context=True),
+            "attn_q": _dense_init(next(ks), d, d),
+            "attn_k": _dense_init(next(ks), d, d),
+            "attn_v": _dense_init(next(ks), d, d // cfg.heads),  # shared V
+            "attn_o": _dense_init(next(ks), d // cfg.heads, d),
+            "gate_attn": _glu_addnorm_init(next(ks), d),
+            "grn_final": _grn_init(next(ks), d, d),
+            "gate_out": _glu_addnorm_init(next(ks), d),
+            "head": _dense_init(next(ks), d, nq),
+        }
+        return p
+
+    # -- features ----------------------------------------------------------
+
+    def _normalize(self, x, valid):
+        """Masked mean/std over the CONTEXT region only (the horizon tail
+        is the prediction target; its stats must not leak)."""
+        cfg = self.cfg
+        v = valid[:, :cfg.context].astype(jnp.float32)
+        xc = x[:, :cfg.context]
+        n = jnp.maximum(v.sum(-1, keepdims=True), 1.0)
+        mu = (xc * v).sum(-1, keepdims=True) / n
+        var = (((xc - mu) * v) ** 2).sum(-1, keepdims=True) / n
+        sd = jnp.sqrt(var + 1e-6)
+        return (x - mu) / sd, mu, sd
+
+    def _known_features(self, B):
+        """sin/cos relative position over the full window: [W, 2]."""
+        w = self.cfg.window
+        pos = jnp.arange(w, dtype=jnp.float32) / w
+        feats = jnp.stack([jnp.sin(2 * jnp.pi * pos),
+                           jnp.cos(2 * jnp.pi * pos)], axis=-1)
+        return jnp.broadcast_to(feats, (B, w, 2))
+
+    def _vsn(self, p_sel, p_vars, embs, static_ctx, cdt):
+        """Variable selection (TFT eq. 6-8). embs: [B, T, nvars, d]."""
+        B, T, nv, d = embs.shape
+        flat = embs.reshape(B, T, nv * d)
+        w = jax.nn.softmax(
+            _grn(p_sel, flat, cdt, context=static_ctx[:, None, :]), axis=-1)
+        proc = jnp.stack([_grn(p_vars[i], embs[:, :, i], cdt)
+                          for i in range(nv)], axis=2)
+        return (proc * w[..., None]).sum(axis=2), w     # [B, T, d], [B, T, nv]
+
+    # -- forward -----------------------------------------------------------
+
+    def _forward(self, params, xn, valid):
+        """Normalized window → (quantiles [B, H, Q], attention [B, Hd, H, W])."""
+        cfg = self.cfg
+        cdt = cfg.compute_dtype
+        B, W = xn.shape
+        Wc, H, d = cfg.context, cfg.horizon, cfg.hidden
+
+        static_ctx = _grn(params["grn_static"],
+                          jnp.broadcast_to(params["static"], (B, d)), cdt)
+
+        # observed past features (value, masked delta, masked value,
+        # validity flag); horizon values are masked out — the model must
+        # not see its own target
+        v = valid.astype(jnp.float32)
+        delta = jnp.diff(xn, prepend=xn[:, :1], axis=-1)
+        past_feats = jnp.stack(
+            [xn * v, delta * v, v, jnp.abs(delta) * v], axis=-1)[:, :Wc]
+        fut_feats = self._known_features(B)
+
+        past_embs = jnp.stack(
+            [_dense(params["emb_past"][i], past_feats[..., i:i + 1], cdt)
+             for i in range(self.N_PAST_VARS)], axis=2)    # [B, Wc, nv, d]
+        fut_embs = jnp.stack(
+            [_dense(params["emb_fut"][i], fut_feats[:, Wc:, i:i + 1], cdt)
+             for i in range(self.N_FUT_VARS)], axis=2)     # [B, H, nv, d]
+
+        past_sel, _ = self._vsn(params["vsn_past"], params["vsn_past_var"],
+                                past_embs, static_ctx, cdt)
+        fut_sel, _ = self._vsn(params["vsn_fut"], params["vsn_fut_var"],
+                               fut_embs, static_ctx, cdt)
+
+        enc_out, (h, c) = _lstm_scan(params["lstm_enc"], past_sel, cdt)
+        dec_out, _ = _lstm_scan(params["lstm_dec"], fut_sel, cdt, h0=h, c0=c)
+        seq = jnp.concatenate([enc_out, dec_out], axis=1)   # [B, W, d]
+        skip = jnp.concatenate([past_sel, fut_sel], axis=1)
+        seq = _glu_addnorm(params["gate_seq"], seq, skip, cdt)
+
+        enriched = _grn(params["grn_enrich"], seq, cdt,
+                        context=static_ctx[:, None, :])
+
+        # interpretable multi-head attention: per-head Q/K, SHARED value
+        # head (Lim et al. §4.4) — queries are the horizon positions only
+        nh = cfg.heads
+        dh = d // nh
+        q = _dense(params["attn_q"], enriched[:, Wc:], cdt)  # [B, H, d]
+        k = _dense(params["attn_k"], enriched, cdt)          # [B, W, d]
+        val = _dense(params["attn_v"], enriched, cdt)        # [B, W, dh]
+        q = q.reshape(B, H, nh, dh).transpose(0, 2, 1, 3)    # [B, nh, H, dh]
+        k = k.reshape(B, W, nh, dh).transpose(0, 2, 1, 3)    # [B, nh, W, dh]
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q.astype(cdt),
+                            k.astype(cdt)).astype(jnp.float32) / np.sqrt(dh)
+        # causal + validity mask: horizon step i sits at absolute Wc+i and
+        # may attend to positions <= Wc+i; invalid past steps are masked
+        key_pos = jnp.arange(W)
+        causal = key_pos[None, :] <= (Wc + jnp.arange(H))[:, None]  # [H, W]
+        key_ok = jnp.concatenate(
+            [valid[:, :Wc], jnp.ones((B, H), bool)], axis=1)        # [B, W]
+        mask = causal[None, None] & key_ok[:, None, None]
+        logits = jnp.where(mask, logits, -1e9)
+        attn = jax.nn.softmax(logits, axis=-1)
+        ctx_h = jnp.einsum("bnqk,bkd->bnqd", attn.astype(cdt),
+                           val.astype(cdt)).astype(jnp.float32)
+        ctx = ctx_h.mean(axis=1)                             # head-mean [B, H, dh]
+        attn_out = _dense(params["attn_o"], ctx, cdt)
+        x_attn = _glu_addnorm(params["gate_attn"], attn_out,
+                              enriched[:, Wc:], cdt)
+
+        ff = _grn(params["grn_final"], x_attn, cdt)
+        out = _glu_addnorm(params["gate_out"], ff, seq[:, Wc:], cdt)
+        quants = _dense(params["head"], out, cdt)            # [B, H, Q]
+        # monotone quantiles: cumulative softplus offsets from the first
+        base = quants[..., :1]
+        steps = jax.nn.softplus(quants[..., 1:])
+        quants = jnp.concatenate(
+            [base, base + jnp.cumsum(steps, axis=-1)], axis=-1)
+        return quants, attn
+
+    # -- public API --------------------------------------------------------
+
+    def forecast(self, params: dict, x: jax.Array,
+                 valid: jax.Array) -> jax.Array:
+        """Quantile forecasts in ORIGINAL units: [B, H, Q] (config 3)."""
+        xn, mu, sd = self._normalize(x, valid)
+        quants, _ = self._forward(params, xn, valid)
+        return quants * sd[..., None] + mu[..., None]
+
+    def attention(self, params: dict, x: jax.Array,
+                  valid: jax.Array) -> jax.Array:
+        """Interpretability surface: attention weights [B, heads, H, W]."""
+        xn, _, _ = self._normalize(x, valid)
+        _, attn = self._forward(params, xn, valid)
+        return attn
+
+    def score(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
+        """Anomaly score: worst violation of the predicted outer-quantile
+        interval by the observed horizon tail, in interval half-widths
+        (z-like for a Gaussian process ⇒ same thresholds as the LSTM/
+        zscore detectors). x: [B, W], valid: [B, W] → [B]."""
+        cfg = self.cfg
+        xn, _, _ = self._normalize(x, valid)
+        quants, _ = self._forward(params, xn, valid)
+        lo, hi = quants[..., 0], quants[..., -1]             # [B, H]
+        y = xn[:, cfg.context:]
+        vt = valid[:, cfg.context:].astype(jnp.float32)
+        half = jnp.maximum((hi - lo) * 0.5, 1e-2)
+        violation = jnp.maximum(lo - y, y - hi)
+        viol_z = jnp.where(vt > 0, violation / half, -jnp.inf).max(axis=-1)
+        # sigma units: the interval edge sits at z_outer (1.28 for an 80%
+        # interval), so a point viol_z half-widths past it has predictive
+        # z = (1 + viol_z) * z_outer — keeps thresholds interchangeable
+        # with the lstm/zscore detectors
+        z_outer = float(-_norm_ppf((1.0 - (cfg.quantiles[-1]
+                                           - cfg.quantiles[0])) / 2.0))
+        score = jnp.where(viol_z > 0.0, (1.0 + viol_z) * z_outer, 0.0)
+        enough = valid[:, :cfg.context].sum(-1) >= cfg.min_history
+        enough &= vt.sum(-1) > 0
+        return jnp.clip(jnp.where(enough, score, 0.0), 0.0, cfg.score_clip)
+
+    def loss(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
+        """Masked quantile (pinball) loss over the horizon region."""
+        cfg = self.cfg
+        xn, _, _ = self._normalize(x, valid)
+        quants, _ = self._forward(params, xn, valid)
+        y = xn[:, cfg.context:, None]                        # [B, H, 1]
+        qs = jnp.asarray(cfg.quantiles, jnp.float32)
+        err = y - quants
+        pinball = jnp.maximum(qs * err, (qs - 1.0) * err)    # [B, H, Q]
+        mask = valid[:, cfg.context:, None].astype(jnp.float32)
+        return (pinball * mask).sum() / jnp.maximum(
+            mask.sum() * len(cfg.quantiles), 1.0)
+
+
+def _norm_ppf(p: float) -> float:
+    """Scalar standard-normal inverse CDF (Acklam approximation) — host
+    side only (used for the score's sigma conversion constant)."""
+    import math
+    a = [-3.969683028665376e+01, 2.209460984245205e+02,
+         -2.759285104469687e+02, 1.383577518672690e+02,
+         -3.066479806614716e+01, 2.506628277459239e+00]
+    b = [-5.447609879822406e+01, 1.615858368580409e+02,
+         -1.556989798598866e+02, 6.680131188771972e+01,
+         -1.328068155288572e+01]
+    c = [-7.784894002430293e-03, -3.223964580411365e-01,
+         -2.400758277161838e+00, -2.549732539343734e+00,
+         4.374664141464968e+00, 2.938163982698783e+00]
+    d = [7.784695709041462e-03, 3.224671290700398e-01,
+         2.445134137142996e+00, 3.754408661907416e+00]
+    plow = 0.02425
+    if p < plow:
+        q = math.sqrt(-2 * math.log(p))
+        return (((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q
+                + c[5]) / ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1)
+    if p > 1 - plow:
+        return -_norm_ppf(1 - p)
+    q = p - 0.5
+    r = q * q
+    return (((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4]) * r
+            + a[5]) * q / (((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r
+                            + b[4]) * r + 1)

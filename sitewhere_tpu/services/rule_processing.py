@@ -154,13 +154,16 @@ class RuleProcessor(BackgroundTaskComponent):
         tenant_id = engine.tenant_id
         session = engine.session
         scored_topic = engine.tenant_topic(TopicNaming.SCORED_EVENTS)
-        consumer = runtime.bus.subscribe(
-            engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
-            group=f"{tenant_id}.rule-processing")
         api = RuleApi(engine)
         em = None
         if engine.emit_alerts:
             em = (await runtime.wait_for_engine("event-management", tenant_id))
+        # subscribe only after every prior await: a cancellation between
+        # subscribe and the try/finally would leak a group member that
+        # keeps its partitions assigned and silently starves the group
+        consumer = runtime.bus.subscribe(
+            engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
+            group=f"{tenant_id}.rule-processing")
         try:
             while True:
                 timeout = session.flush_wait_s if session else 0.2

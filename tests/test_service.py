@@ -85,3 +85,72 @@ def test_api_and_wait_for_api(run):
         await rt.stop()
 
     run(main())
+
+
+def test_add_tenant_creates_engine_exactly_once(run):
+    """The manager's bootstrap scan and the tenant-model-updates broadcast
+    race on a freshly added tenant; the engine must be built once, not
+    created-then-replaced (a replaced engine's consumers can leak group
+    membership and starve the data plane — regression)."""
+
+    async def main():
+        rt = ServiceRuntime(InstanceSettings(instance_id="once"))
+        echo = rt.add_service(EchoService(rt))
+        created = []
+        orig = EchoService.create_tenant_engine
+
+        def counting(self, tenant):
+            engine = orig(self, tenant)
+            created.append(engine)
+            return engine
+
+        EchoService.create_tenant_engine = counting
+        try:
+            await rt.start()
+            await rt.add_tenant(TenantConfig(tenant_id="acme"))
+            await asyncio.sleep(0.3)  # let any late broadcast record land
+            assert len(created) == 1, f"engine created {len(created)}x"
+            assert echo.engine("acme") is created[0]
+            # a real config update must still spin a fresh engine
+            await rt.update_tenant(TenantConfig(tenant_id="acme", name="v2"))
+            assert len(created) == 2
+        finally:
+            EchoService.create_tenant_engine = orig
+            await rt.stop()
+
+    run(main())
+
+
+def test_tenant_consumer_groups_have_single_member(run):
+    """Every per-tenant consumer group ends with exactly one live member
+    after startup (a stale second member keeps partitions assigned and
+    silently drops that topic's traffic — regression for the
+    rule-processing subscribe/cancellation leak)."""
+
+    async def main():
+        from sitewhere_tpu.services import (
+            DeviceManagementService,
+            DeviceStateService,
+            EventManagementService,
+            EventSourcesService,
+            InboundProcessingService,
+            RuleProcessingService,
+        )
+
+        rt = ServiceRuntime(InstanceSettings(instance_id="grp"))
+        for cls in (DeviceManagementService, EventSourcesService,
+                    InboundProcessingService, EventManagementService,
+                    DeviceStateService, RuleProcessingService):
+            rt.add_service(cls(rt))
+        await rt.start()
+        await rt.add_tenant(TenantConfig(tenant_id="acme", sections={
+            "rule-processing": {"model": "zscore",
+                                "model_config": {"window": 32}}}))
+        await asyncio.sleep(0.3)
+        for group, state in rt.bus._groups.items():
+            if group.startswith("acme."):
+                assert len(state.members) == 1, \
+                    f"group {group} has {len(state.members)} members"
+        await rt.stop()
+
+    run(main())

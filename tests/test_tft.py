@@ -1,0 +1,128 @@
+"""TFT forecaster tests (config 3 [BASELINE.json]): protocol compliance,
+quantile-loss training, forecast calibration, anomaly separation,
+per-tenant vmap [SURVEY.md §4 golden-number model tests]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sitewhere_tpu.models import build_model
+from sitewhere_tpu.models.tft import TftConfig, TftForecaster
+
+W, H = 48, 8
+
+
+def sine_windows(b=64, w=W, seed=0, anomaly_rows=(), noise=0.1):
+    rng = np.random.default_rng(seed)
+    t = np.arange(w)
+    phase = rng.uniform(0, 2 * np.pi, (b, 1))
+    x = 20 + 2 * np.sin(2 * np.pi * t / 16 + phase) \
+        + noise * rng.standard_normal((b, w))
+    for r in anomaly_rows:
+        x[r, -1] += 12.0
+    return x.astype(np.float32), np.ones((b, w), bool)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One trained TFT shared across tests (training dominates runtime)."""
+    model = TftForecaster(TftConfig(window=W, horizon=H, hidden=16, heads=2))
+    params = model.init(jax.random.PRNGKey(0))
+    x, v = sine_windows(b=256, seed=1)
+    xj, vj = jnp.asarray(x), jnp.asarray(v)
+    opt = optax.adam(5e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(model.loss)(params, xj, vj)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    losses = []
+    for _ in range(150):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    return model, params, losses
+
+
+def test_shapes_jit_and_protocol():
+    model = TftForecaster(TftConfig(window=W, horizon=H, hidden=16, heads=2))
+    params = model.init(jax.random.PRNGKey(0))
+    x, v = sine_windows(b=8)
+    scores = jax.jit(model.score)(params, jnp.asarray(x), jnp.asarray(v))
+    assert scores.shape == (8,) and bool(jnp.isfinite(scores).all())
+    loss = jax.jit(model.loss)(params, jnp.asarray(x), jnp.asarray(v))
+    assert loss.shape == () and bool(jnp.isfinite(loss))
+    fc = jax.jit(model.forecast)(params, jnp.asarray(x), jnp.asarray(v))
+    assert fc.shape == (8, H, 3)
+    attn = model.attention(params, jnp.asarray(x), jnp.asarray(v))
+    assert attn.shape == (8, 2, H, W)
+    # attention rows are normalized distributions
+    assert np.allclose(np.asarray(attn).sum(-1), 1.0, atol=1e-3)
+
+
+def test_quantiles_are_monotone():
+    model = TftForecaster(TftConfig(window=W, horizon=H, hidden=16, heads=2))
+    params = model.init(jax.random.PRNGKey(3))
+    x, v = sine_windows(b=16, seed=7)
+    fc = np.asarray(model.forecast(params, jnp.asarray(x), jnp.asarray(v)))
+    assert (np.diff(fc, axis=-1) >= -1e-5).all()
+
+
+def test_training_reduces_pinball_loss(trained):
+    _, _, losses = trained
+    assert losses[-1] < losses[0] * 0.5, \
+        f"no learning: {losses[0]:.4f} -> {losses[-1]:.4f}"
+
+
+def test_forecast_tracks_signal_and_calibrates(trained):
+    model, params, _ = trained
+    x, v = sine_windows(b=128, seed=9)
+    fc = np.asarray(model.forecast(params, jnp.asarray(x), jnp.asarray(v)))
+    y = x[:, model.cfg.context:]
+    med = fc[..., 1]
+    # median forecast beats a persistence baseline on the sinusoid
+    persist = np.repeat(x[:, model.cfg.context - 1:model.cfg.context], H, 1)
+    assert np.abs(med - y).mean() < np.abs(persist - y).mean()
+    # outer interval covers most observations (80% nominal; allow slack)
+    cover = ((y >= fc[..., 0]) & (y <= fc[..., 2])).mean()
+    assert cover > 0.6, f"coverage {cover:.2f}"
+
+
+def test_anomaly_separation(trained):
+    model, params, _ = trained
+    x, v = sine_windows(b=32, seed=11, anomaly_rows=(4, 20))
+    scores = np.asarray(model.score(params, jnp.asarray(x), jnp.asarray(v)))
+    clean = np.delete(scores, [4, 20])
+    assert scores[4] > 4.0 and scores[20] > 4.0
+    assert scores[4] > clean.max() * 2
+
+
+def test_insufficient_history_scores_zero():
+    model = TftForecaster(TftConfig(window=W, horizon=H, hidden=16,
+                                    heads=2, min_history=16))
+    params = model.init(jax.random.PRNGKey(0))
+    x, v = sine_windows(b=4)
+    v[:2, :-12] = False     # only 4 valid context points (< min_history)
+    scores = np.asarray(model.score(params, jnp.asarray(x), jnp.asarray(v)))
+    assert (scores[:2] == 0).all()
+
+
+def test_vmap_over_stacked_tenant_params():
+    model = TftForecaster(TftConfig(window=W, horizon=H, hidden=16, heads=2))
+    p0, p1 = model.init(jax.random.PRNGKey(0)), model.init(jax.random.PRNGKey(1))
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
+    x, v = sine_windows(b=4)
+    xs = jnp.stack([jnp.asarray(x)] * 2)
+    vs = jnp.stack([jnp.asarray(v)] * 2)
+    scores = jax.vmap(model.score)(stacked, xs, vs)
+    assert scores.shape == (2, 4)
+    assert not np.allclose(np.asarray(scores[0]), np.asarray(scores[1]))
+
+
+def test_registry_builds_tft():
+    m = build_model("tft", window=32, horizon=4, hidden=8, heads=2)
+    assert isinstance(m, TftForecaster) and m.cfg.horizon == 4
