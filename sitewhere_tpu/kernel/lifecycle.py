@@ -163,7 +163,12 @@ class LifecycleComponent:
     async def stop(self, monitor: Optional[LifecycleProgressMonitor] = None) -> None:
         monitor = monitor or LifecycleProgressMonitor()
         if self.status in (LifecycleStatus.STOPPED, LifecycleStatus.TERMINATED,
-                           LifecycleStatus.INITIALIZED):
+                           LifecycleStatus.INITIALIZED,
+                           LifecycleStatus.INITIALIZATION_ERROR):
+            # INITIALIZATION_ERROR: nothing was started, so there is nothing
+            # to stop — treating it as fatal would wedge the component
+            # forever (a tenant engine that failed init could never be
+            # replaced by a config-update restart)
             return  # already not running
         if self.status not in _CAN_STOP:
             raise LifecycleException(

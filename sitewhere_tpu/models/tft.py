@@ -133,8 +133,14 @@ class TftForecaster:
             raise ValueError(
                 f"hidden ({cfg.hidden}) must be a positive multiple of "
                 f"heads ({cfg.heads})")
-        if len(cfg.quantiles) < 2 or list(cfg.quantiles) != sorted(cfg.quantiles):
-            raise ValueError("quantiles must be ascending, at least 2")
+        if (len(cfg.quantiles) < 2
+                or any(q2 <= q1 for q1, q2 in zip(cfg.quantiles,
+                                                  cfg.quantiles[1:]))
+                or cfg.quantiles[0] <= 0.0 or cfg.quantiles[-1] >= 1.0):
+            # strictly increasing inside (0, 1): duplicates make z_outer 0
+            # (scores silently constant) and 0/1 endpoints hit ppf's domain
+            raise ValueError(
+                "quantiles must be strictly increasing within (0, 1)")
         self.cfg = cfg
 
     # -- params ------------------------------------------------------------

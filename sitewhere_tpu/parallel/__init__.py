@@ -18,5 +18,7 @@ from sitewhere_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from sitewhere_tpu.parallel.tenant_stack import TenantStack
 
-__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_batch"]
+__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_batch",
+           "TenantStack"]

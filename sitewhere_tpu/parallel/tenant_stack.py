@@ -1,0 +1,181 @@
+"""Per-tenant model multiplexing: stacked params + vmap over a mesh.
+
+Config 4 [BASELINE.json: "multi-tenant 100k-device ingest, per-tenant
+model sharding on TPU mesh"]. The reference isolates tenants with one
+engine (and one Groovy script set) per tenant [SURVEY.md §2.1
+"Multitenant engine mgmt"]; scoring N tenants there means N independent
+CPU evaluators. The TPU-native answer [SURVEY.md §2.4 "Per-tenant model
+sharding", §7 hard part b]:
+
+- every tenant's params for one architecture are **stacked** on a leading
+  tenant axis (one pytree, leaves `[T_cap, ...]`);
+- the stack is sharded over the mesh `model` axis, scoring batches over
+  the `data` axis, so tenant slices live resident on their devices and
+  XLA never moves them;
+- `vmap(model.score)` over the tenant axis scores **all tenants in one
+  XLA call** — no per-tenant dispatch, no per-tenant recompile;
+- capacity grows in power-of-two steps (`T_cap`), so adding a tenant
+  recompiles only when a capacity bucket is crossed, and one tenant's
+  param swap is a device-side `.at[slot].set` scatter.
+
+Single-chip degenerate case (the bench's real v5e chip): mesh=None, the
+stack is just device-resident, and the win is cross-tenant batching — one
+kernel launch for the whole fleet instead of per-tenant calls.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sitewhere_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class TenantStack:
+    """Stacked per-tenant params for one model architecture.
+
+    Slot management: tenants occupy integer slots in `[0, capacity)`;
+    removed tenants free their slot for reuse. Unoccupied slots hold
+    init params and are masked out by callers (they score garbage that
+    nobody reads — cheaper than dynamic shapes).
+    """
+
+    def __init__(self, model, mesh: Optional[Mesh] = None, seed: int = 0):
+        self.model = model
+        self.mesh = mesh
+        self.seed = seed
+        self.slots: dict[str, int] = {}
+        self.versions: dict[str, int] = {}
+        self._free: list[int] = []
+        self.capacity = 0
+        self.stacked = None           # pytree, leaves [T_cap, ...]
+        self._fns: dict[tuple[int, int], Callable] = {}
+        self._init_params = model.init(jax.random.PRNGKey(seed))
+
+    # -- sharding helpers ---------------------------------------------------
+
+    @property
+    def _model_ax(self) -> int:
+        return self.mesh.shape[MODEL_AXIS] if self.mesh is not None else 1
+
+    @property
+    def _data_ax(self) -> int:
+        return self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
+
+    def _param_sharding(self, leaf):
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(MODEL_AXIS, *([None] * (leaf.ndim - 1))))
+
+    def _place_stack(self, stacked):
+        if self.mesh is None:
+            return jax.device_put(stacked)
+        return jax.tree.map(
+            lambda leaf: jax.device_put(leaf, self._param_sharding(leaf)), stacked)
+
+    def _batch_sharding(self, ndim: int):
+        if self.mesh is None:
+            return None
+        return NamedSharding(
+            self.mesh, P(MODEL_AXIS, DATA_AXIS, *([None] * (ndim - 2))))
+
+    # -- capacity / slots ---------------------------------------------------
+
+    def _grow(self, needed: int) -> None:
+        """Grow capacity to a power-of-two multiple of the model axis."""
+        m = self._model_ax
+        cap = m * _next_pow2((needed + m - 1) // m)
+        if cap <= self.capacity:
+            return
+        old_cap, old = self.capacity, self.stacked
+        tiled = jax.tree.map(
+            lambda leaf: jnp.broadcast_to(leaf, (cap, *leaf.shape)),
+            self._init_params)
+        if old is not None:
+            tiled = jax.tree.map(
+                lambda t, o: t.at[:old_cap].set(o), tiled, old)
+        self.stacked = self._place_stack(tiled)
+        self.capacity = cap
+        self._fns.clear()  # shapes changed; recompile lazily per bucket
+
+    def add_tenant(self, tenant_id: str, params: Optional[dict] = None) -> int:
+        if tenant_id in self.slots:
+            raise ValueError(f"tenant {tenant_id!r} already stacked")
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = len(self.slots)
+            self._grow(slot + 1)
+        self.slots[tenant_id] = slot
+        self.versions[tenant_id] = 0
+        # always (re)write the slice: a reused freed slot still holds the
+        # departed tenant's swapped-in weights (cross-tenant leak otherwise)
+        self.set_params(tenant_id,
+                        params if params is not None else self._init_params,
+                        _bump=False)
+        return slot
+
+    def remove_tenant(self, tenant_id: str) -> None:
+        slot = self.slots.pop(tenant_id, None)
+        self.versions.pop(tenant_id, None)
+        if slot is not None:
+            self._free.append(slot)
+
+    def set_params(self, tenant_id: str, params: dict, *, _bump: bool = True) -> int:
+        """Hot-swap one tenant's slice (checkpoint rollout): a device-side
+        scatter; the rest of the stack is untouched."""
+        slot = self.slots[tenant_id]
+        self.stacked = jax.tree.map(
+            lambda s, p: s.at[slot].set(p.astype(s.dtype)), self.stacked, params)
+        if self.mesh is not None:  # keep the shard placement committed
+            self.stacked = self._place_stack(self.stacked)
+        if _bump:
+            self.versions[tenant_id] += 1
+        return self.versions[tenant_id]
+
+    def get_params(self, tenant_id: str) -> dict:
+        slot = self.slots[tenant_id]
+        return jax.tree.map(lambda s: np.asarray(s[slot]), self.stacked)
+
+    # -- scoring ------------------------------------------------------------
+
+    def _fn(self, b: int) -> Callable:
+        key = (self.capacity, b)
+        fn = self._fns.get(key)
+        if fn is None:
+            model = self.model
+            fn = jax.jit(lambda p, x, v: jax.vmap(model.score)(p, x, v))
+            self._fns[key] = fn
+        return fn
+
+    def pad_batch(self, n: int) -> int:
+        """Round a per-tenant row count up to a data-axis multiple."""
+        d = self._data_ax
+        return ((max(n, 1) + d - 1) // d) * d
+
+    def score(self, x: np.ndarray, valid: np.ndarray):
+        """Score all tenants at once. x/valid: [T_cap, B, W] → device
+        array [T_cap, B] (caller slices per tenant and np.asarray's)."""
+        assert x.shape[0] == self.capacity, (x.shape, self.capacity)
+        sh = self._batch_sharding(x.ndim)
+        xd = jax.device_put(x, sh)
+        vd = jax.device_put(valid, sh)
+        return self._fn(x.shape[1])(self.stacked, xd, vd)
+
+    def warm(self, b: int, window: int) -> jax.Array:
+        """Dispatch one dummy scoring call for batch bucket `b` (compile
+        warmer; caller awaits readiness off-loop)."""
+        x = np.zeros((self.capacity, b, window), np.float32)
+        v = np.zeros((self.capacity, b, window), bool)
+        return self.score(x, v)

@@ -1,3 +1,5 @@
+from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool, TenantSlot
 from sitewhere_tpu.scoring.server import ScoringSession, ScoringConfig
 
-__all__ = ["ScoringSession", "ScoringConfig"]
+__all__ = ["ScoringSession", "ScoringConfig", "SharedScoringPool",
+           "PoolConfig", "TenantSlot"]

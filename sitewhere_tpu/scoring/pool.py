@@ -1,0 +1,279 @@
+"""Shared multi-tenant scoring pool: one XLA call scores every tenant.
+
+Config 4 [BASELINE.json]. The per-tenant `ScoringSession` (server.py)
+gives each tenant its own compiled functions and its own flush cadence —
+right for a handful of big tenants, wasteful for hundreds of small ones
+(N kernel launches per window, N compile caches). This pool is the other
+operating point [SURVEY.md §7 hard part b]:
+
+- all tenants of one model architecture share a `TenantStack` (stacked
+  params, mesh-sharded over the `model` axis);
+- admissions from every tenant land in per-tenant queues; one flusher
+  with one admission deadline drains them together;
+- each flush builds a `[T_cap, B, W]` window tensor (per-tenant telemetry
+  gathers on host), runs ONE vmapped scoring call, then fans results back
+  out to each tenant's scored-events topic via its deliver callback.
+
+The pool is keyed by (model name, model config): tenants selecting the
+same architecture share a stack regardless of their thresholds (applied
+host-side per tenant) or trained params (per-slot slices).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch, ScoredBatch
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+from sitewhere_tpu.parallel.tenant_stack import TenantStack
+from sitewhere_tpu.persistence.telemetry import TelemetryStore
+
+logger = logging.getLogger(__name__)
+
+Deliver = Callable[[ScoredBatch], Awaitable[None]]
+
+
+@dataclass(frozen=True)
+class PoolConfig:
+    batch_buckets: tuple[int, ...] = (256, 1024, 4096)
+    batch_window_ms: float = 2.0
+    mtype: int = 0
+    seed: int = 0
+
+
+@dataclass
+class _TenantEntry:
+    tenant_id: str
+    telemetry: TelemetryStore
+    threshold: float
+    deliver: Deliver
+    pending: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = field(
+        default_factory=list)  # (device_index, ts, ingest_monotonic)
+    pending_n: int = 0
+    ctx: Optional[BatchContext] = None
+
+
+class TenantSlot:
+    """Per-tenant handle handed to the rule-processing engine; mirrors the
+    `ScoringSession` admission surface so the processor loop treats both
+    the same way (pool-managed flushing → `flush_due` is always False)."""
+
+    def __init__(self, pool: "SharedScoringPool", tenant_id: str):
+        self.pool = pool
+        self.tenant_id = tenant_id
+        self.scored_meter = pool.scored_meter
+        self.latency = pool.latency
+
+    @property
+    def ready(self) -> bool:
+        return self.pool.ready
+
+    @property
+    def flush_due(self) -> bool:
+        return False
+
+    @property
+    def flush_wait_s(self) -> float:
+        return 0.2
+
+    @property
+    def version(self) -> int:
+        return self.pool.stack.versions.get(self.tenant_id, 0)
+
+    def admit(self, batch: MeasurementBatch) -> None:
+        self.pool.admit(self.tenant_id, batch)
+
+    def swap_params(self, params: dict) -> int:
+        return self.pool.stack.set_params(self.tenant_id, params)
+
+
+class SharedScoringPool:
+    """One stack + one flusher for every tenant of one model architecture."""
+
+    def __init__(self, model, metrics: MetricsRegistry,
+                 cfg: PoolConfig = PoolConfig(), mesh=None):
+        self.model = model
+        self.cfg = cfg
+        self.stack = TenantStack(model, mesh=mesh, seed=cfg.seed)
+        self.tenants: dict[str, _TenantEntry] = {}
+        self.ready = True          # flips False while capacity warms up
+        self._wake = asyncio.Event()
+        self._deadline: Optional[float] = None
+        self._flusher: Optional[asyncio.Task] = None
+        self._warmup: Optional[asyncio.Task] = None
+        self._warmed_capacity = 0
+        self.scored_meter = metrics.meter("scoring.events_scored")
+        self.latency = metrics.histogram("scoring.e2e_latency_s")
+        self.batch_latency = metrics.histogram("scoring.batch_latency_s")
+        self.anomalies = metrics.counter("scoring.anomalies_detected")
+        self.flush_rounds = metrics.counter("scoring.pool_flush_rounds")
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, tenant_id: str, telemetry: TelemetryStore,
+                 threshold: float, deliver: Deliver,
+                 params: Optional[dict] = None) -> TenantSlot:
+        if tenant_id in self.tenants:
+            raise ValueError(f"tenant {tenant_id!r} already registered")
+        self.stack.add_tenant(tenant_id, params)
+        self.tenants[tenant_id] = _TenantEntry(
+            tenant_id, telemetry, threshold, deliver)
+        self._ensure_started()
+        if self.stack.capacity != self._warmed_capacity:
+            self._start_warmup()
+        return TenantSlot(self, tenant_id)
+
+    def unregister(self, tenant_id: str) -> None:
+        self.tenants.pop(tenant_id, None)
+        self.stack.remove_tenant(tenant_id)
+
+    def _ensure_started(self) -> None:
+        if self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.create_task(
+                self._run(), name=f"scoring-pool/{self.model.name}")
+
+    def _start_warmup(self) -> None:
+        if self._warmup is not None and not self._warmup.done():
+            self._warmup.cancel()
+        self.ready = False
+        self._warmup = asyncio.create_task(
+            self._warm_async(), name=f"scoring-pool/{self.model.name}/warmup")
+
+    async def _warm_async(self) -> None:
+        """Compile every batch bucket at the current capacity off the hot
+        path; flushes are held (and backlog capped) meanwhile."""
+        cap = self.stack.capacity
+        w = self.model.cfg.window
+        for b in self.cfg.batch_buckets:
+            out = self.stack.warm(self.stack.pad_batch(b), w)
+            while not out.is_ready():
+                await asyncio.sleep(0.01)
+            if self.stack.capacity != cap:  # grew again mid-warmup; restart
+                self._start_warmup()
+                return
+        self._warmed_capacity = cap
+        self.ready = True
+        self._wake.set()
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, tenant_id: str, batch: MeasurementBatch) -> None:
+        entry = self.tenants[tenant_id]
+        mask = batch.mtype == self.cfg.mtype
+        dev = batch.device_index if mask.all() else batch.device_index[mask]
+        ts = batch.ts if mask.all() else batch.ts[mask]
+        if dev.shape[0] == 0:
+            return
+        ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
+        entry.pending.append((dev, ts, ingest))
+        entry.pending_n += dev.shape[0]
+        entry.ctx = batch.ctx
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
+        # cap the backlog while compiles run (mirror ScoringSession.admit)
+        cap = 16 * self.cfg.batch_buckets[-1]
+        while not self.ready and entry.pending_n > cap and len(entry.pending) > 1:
+            old = entry.pending.pop(0)
+            entry.pending_n -= old[0].shape[0]
+        self._wake.set()
+
+    # -- flushing -----------------------------------------------------------
+
+    @property
+    def _total_pending(self) -> int:
+        return sum(e.pending_n for e in self.tenants.values())
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.cfg.batch_buckets:
+            if n <= b:
+                return self.stack.pad_batch(b)
+        return self.stack.pad_batch(self.cfg.batch_buckets[-1])
+
+    async def _run(self) -> None:
+        while True:
+            timeout = 0.2
+            if self.ready and self._deadline is not None:
+                timeout = max(self._deadline - time.monotonic(), 0.0)
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if not self.ready or self._total_pending == 0:
+                continue
+            if (self._deadline is not None
+                    and time.monotonic() >= self._deadline) \
+                    or self._total_pending >= self.cfg.batch_buckets[-1]:
+                self._deadline = None
+                t0 = time.monotonic()
+                await self.flush_all()
+                self.batch_latency.observe(time.monotonic() - t0)
+
+    async def flush_all(self) -> None:
+        """Drain every tenant's queue in rounds of one stacked call each."""
+        w = self.model.cfg.window
+        while self._total_pending > 0:
+            # take up to one bucket of rows from every tenant this round
+            takes: dict[str, tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+            max_n = 0
+            for tid, e in self.tenants.items():
+                if e.pending_n == 0:
+                    continue
+                dev = np.concatenate([p[0] for p in e.pending])
+                ts = np.concatenate([p[1] for p in e.pending])
+                ing = np.concatenate([p[2] for p in e.pending])
+                cut = min(dev.shape[0], self._bucket_for(dev.shape[0]))
+                if cut < dev.shape[0]:
+                    e.pending = [(dev[cut:], ts[cut:], ing[cut:])]
+                    e.pending_n = dev.shape[0] - cut
+                else:
+                    e.pending, e.pending_n = [], 0
+                takes[tid] = (dev[:cut], ts[:cut], ing[:cut])
+                max_n = max(max_n, cut)
+            if not takes:
+                return
+            b = self._bucket_for(max_n)
+            cap = self.stack.capacity
+            x = np.zeros((cap, b, w), np.float32)
+            valid = np.zeros((cap, b, w), bool)
+            for tid, (dev, _, _) in takes.items():
+                slot = self.stack.slots[tid]
+                n = dev.shape[0]
+                x[slot, :n], valid[slot, :n] = \
+                    self.tenants[tid].telemetry.window(dev, w, mtype=self.cfg.mtype)
+            scores_all = np.asarray(self.stack.score(x, valid))
+            now = time.monotonic()
+            self.flush_rounds.inc()
+            for tid, (dev, ts, ing) in takes.items():
+                e = self.tenants.get(tid)
+                if e is None:  # unregistered mid-flight
+                    continue
+                slot = self.stack.slots[tid]
+                n = dev.shape[0]
+                scores = scores_all[slot, :n].astype(np.float32)
+                is_anom = scores >= e.threshold
+                self.scored_meter.mark(n)
+                self.latency.observe_array(now - ing)
+                n_anom = int(is_anom.sum())
+                if n_anom:
+                    self.anomalies.inc(n_anom)
+                ctx = e.ctx or BatchContext(tenant_id=tid, source="pool")
+                scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
+                                     model_version=self.stack.versions[tid])
+                try:
+                    await e.deliver(scored)
+                except Exception:  # noqa: BLE001 - one tenant can't sink the pool
+                    logger.exception("pool deliver failed for tenant %s", tid)
+            await asyncio.sleep(0)
+
+    def close(self) -> None:
+        for task in (self._flusher, self._warmup):
+            if task is not None and not task.done():
+                task.cancel()
+        self._flusher = self._warmup = None

@@ -20,6 +20,16 @@ Tenant config section `rule-processing`:
   threshold: 4.0
   batch_window_ms: 2.0
   emit_alerts: true
+  shared: false          # true → score via the multi-tenant pool (config 4)
+  mesh: {data: 4, model: 2}   # optional TPU mesh for the shared pool
+
+Two scoring modes [SURVEY.md §7 hard part b]:
+- dedicated (`shared: false`): a per-tenant `ScoringSession` — own
+  compiled buckets, own flush cadence; right for a few big tenants.
+- pooled (`shared: true`): all tenants of one architecture share a
+  `TenantStack` (params stacked on a tenant axis, sharded over the mesh
+  `model` axis) and are scored in ONE vmapped XLA call per flush —
+  config 4's 100k-device multi-tenant operating point.
 """
 
 from __future__ import annotations
@@ -38,11 +48,26 @@ from sitewhere_tpu.kernel.bus import TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.models.registry import build_model
+from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool, TenantSlot
 from sitewhere_tpu.scoring.server import ScoringConfig, ScoringSession
 
 logger = logging.getLogger(__name__)
 
 Hook = Callable[[object, "RuleApi"], Awaitable[None]]
+
+
+def anomaly_alerts(scored: ScoredBatch, model_name: Optional[str]) -> AlertBatch:
+    """Anomalous scored events → system alerts (source='model')."""
+    idx = np.nonzero(scored.is_anomaly)[0]
+    return AlertBatch(
+        ctx=scored.ctx,
+        device_index=scored.device_index[idx],
+        level=np.full(idx.shape[0], 2, np.uint8),  # ERROR
+        type=[f"anomaly.{model_name}"] * idx.shape[0],
+        message=[f"anomaly score {scored.score[i]:.2f} "
+                 f"(model v{scored.model_version})" for i in idx],
+        ts=scored.ts[idx],
+        source="model")
 
 
 @dataclass
@@ -82,7 +107,10 @@ class RuleProcessingEngine(TenantEngine):
                                   self.runtime.settings.scoring_batch_buckets)),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
+        self.shared: bool = cfg.get("shared", False)
+        self.mesh_spec: Optional[dict] = cfg.get("mesh")
         self.session: Optional[ScoringSession] = None
+        self.pool_slot: Optional[TenantSlot] = None
         self.hooks: dict[str, Hook] = {}
         # script manager: uploaded python scripts become hooks (reference:
         # Groovy stream processors synced per tenant, SURVEY.md §2.1)
@@ -95,9 +123,18 @@ class RuleProcessingEngine(TenantEngine):
         self.add_child(self.processor)
 
     async def _do_initialize(self, monitor) -> None:
-        if self.model_name:
-            em = await self.runtime.wait_for_engine("event-management",
-                                                    self.tenant_id)
+        if not self.model_name:
+            return
+        em = await self.runtime.wait_for_engine("event-management",
+                                                self.tenant_id)
+        if self.shared:
+            pool = self.service.shared_pool(
+                self.model_name, self.model_config, self.scoring_cfg,
+                self.mesh_spec)
+            self.pool_slot = pool.register(
+                self.tenant_id, em.telemetry, self.scoring_cfg.threshold,
+                self._deliver_scored)
+        else:
             model = build_model(self.model_name, **self.model_config)
             self.session = ScoringSession(
                 model, em.telemetry, self.runtime.metrics, self.scoring_cfg)
@@ -116,6 +153,19 @@ class RuleProcessingEngine(TenantEngine):
             task.cancel()
         if self.session is not None:
             self.session.close()
+        if self.pool_slot is not None:
+            self.pool_slot.pool.unregister(self.tenant_id)
+            self.pool_slot = None
+
+    async def _deliver_scored(self, scored: ScoredBatch) -> None:
+        """Pool flush sink: publish scored events + emit anomaly alerts
+        (the dedicated-session path does the same in RuleProcessor)."""
+        await self.runtime.bus.produce(
+            self.tenant_topic(TopicNaming.SCORED_EVENTS), scored,
+            key=scored.ctx.source)
+        if self.emit_alerts and scored.is_anomaly.any():
+            em = self.runtime.api("event-management").management(self.tenant_id)
+            em.add_alert_batch(anomaly_alerts(scored, self.model_name))
 
     # -- extension points --------------------------------------------------
 
@@ -138,9 +188,10 @@ class RuleProcessingEngine(TenantEngine):
 
     def swap_model_params(self, params: dict) -> int:
         """Hot-swap scoring params (called on checkpoint rollout)."""
-        if self.session is None:
+        sink = self.session or self.pool_slot
+        if sink is None:
             raise RuntimeError("no model session configured")
-        return self.session.swap_params(params)
+        return sink.swap_params(params)
 
 
 class RuleProcessor(BackgroundTaskComponent):
@@ -152,6 +203,9 @@ class RuleProcessor(BackgroundTaskComponent):
         engine = self.engine
         runtime = engine.runtime
         tenant_id = engine.tenant_id
+        # sink: dedicated session or the shared pool's tenant slot (the pool
+        # flushes itself; slot.flush_due is constant-False)
+        sink = engine.session or engine.pool_slot
         session = engine.session
         scored_topic = engine.tenant_topic(TopicNaming.SCORED_EVENTS)
         api = RuleApi(engine)
@@ -166,13 +220,13 @@ class RuleProcessor(BackgroundTaskComponent):
             group=f"{tenant_id}.rule-processing")
         try:
             while True:
-                timeout = session.flush_wait_s if session else 0.2
+                timeout = sink.flush_wait_s if sink else 0.2
                 records = await consumer.poll(max_records=64,
                                               timeout=max(timeout, 0.001))
                 for record in records:
                     value = record.value
-                    if session is not None and isinstance(value, MeasurementBatch):
-                        session.admit(value)
+                    if sink is not None and isinstance(value, MeasurementBatch):
+                        sink.admit(value)
                     # snapshot: uploads may mutate hooks mid-await
                     for name, hook in list(engine.hooks.items()):
                         try:
@@ -185,29 +239,54 @@ class RuleProcessor(BackgroundTaskComponent):
                         await runtime.bus.produce(scored_topic, scored,
                                                   key=scored.ctx.source)
                         if em is not None and scored.is_anomaly.any():
-                            self._emit_anomaly_alerts(em, scored)
+                            em.add_alert_batch(
+                                anomaly_alerts(scored, engine.model_name))
                 consumer.commit()
         finally:
             consumer.close()
-
-    def _emit_anomaly_alerts(self, em, scored: ScoredBatch) -> None:
-        """Anomalous events → system alerts (source='model')."""
-        idx = np.nonzero(scored.is_anomaly)[0]
-        batch = AlertBatch(
-            ctx=scored.ctx,
-            device_index=scored.device_index[idx],
-            level=np.full(idx.shape[0], 2, np.uint8),  # ERROR
-            type=[f"anomaly.{self.engine.model_name}"] * idx.shape[0],
-            message=[f"anomaly score {scored.score[i]:.2f} "
-                     f"(model v{scored.model_version})" for i in idx],
-            ts=scored.ts[idx],
-            source="model")
-        em.add_alert_batch(batch)
 
 
 class RuleProcessingService(Service):
     identifier = "rule-processing"
     multitenant = True
 
+    def __init__(self, runtime):
+        super().__init__(runtime)
+        self._pools: dict[tuple, SharedScoringPool] = {}
+
     def create_tenant_engine(self, tenant: TenantConfig) -> RuleProcessingEngine:
         return RuleProcessingEngine(self, tenant)
+
+    def shared_pool(self, model_name: str, model_config: dict,
+                    scoring_cfg: ScoringConfig,
+                    mesh_spec: Optional[dict] = None) -> SharedScoringPool:
+        """Get-or-create the multi-tenant pool for one architecture
+        (config 4). Keyed by (model, config, channel): tenants selecting
+        the same architecture share one stacked-params scorer."""
+        # canonical JSON keeps the key hashable for list/dict config values
+        import json
+
+        key = (model_name,
+               json.dumps(model_config, sort_keys=True, default=str),
+               scoring_cfg.mtype)
+        pool = self._pools.get(key)
+        if pool is None:
+            mesh = None
+            if mesh_spec:
+                from sitewhere_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh(data=mesh_spec.get("data"),
+                                 model=mesh_spec.get("model", 1))
+            model = build_model(model_name, **model_config)
+            pool = SharedScoringPool(
+                model, self.runtime.metrics,
+                PoolConfig(batch_buckets=scoring_cfg.buckets,
+                           batch_window_ms=scoring_cfg.batch_window_ms,
+                           mtype=scoring_cfg.mtype, seed=scoring_cfg.seed),
+                mesh=mesh)
+            self._pools[key] = pool
+        return pool
+
+    async def _do_stop(self, monitor) -> None:
+        for pool in self._pools.values():
+            pool.close()
+        self._pools.clear()
