@@ -14,6 +14,8 @@ leading tenant axis) treat every model identically. bfloat16 matmuls on
 the MXU; float32 accumulations.
 """
 
+from sitewhere_tpu.models.gnn import GnnConfig, GnnMaintenanceModel
+from sitewhere_tpu.models.graph import FEATURE_DIM, FleetGraph, build_fleet_graph
 from sitewhere_tpu.models.lstm import LstmConfig, LstmAnomalyModel
 from sitewhere_tpu.models.tft import TftConfig, TftForecaster
 from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
@@ -23,5 +25,7 @@ __all__ = [
     "LstmConfig", "LstmAnomalyModel",
     "TftConfig", "TftForecaster",
     "ZScoreConfig", "ZScoreModel",
+    "GnnConfig", "GnnMaintenanceModel",
+    "FleetGraph", "build_fleet_graph", "FEATURE_DIM",
     "MODEL_REGISTRY", "build_model",
 ]

@@ -1,0 +1,129 @@
+"""GNN predictive-maintenance model over the device-asset graph
+(config 5 [BASELINE.json]: "fleet-scale predictive maintenance: GNN over
+device-asset graph (v5p-64)").
+
+The reference has no ML at all [SURVEY.md §1 L6]; its device-asset graph
+exists implicitly as `DeviceAssignment` rows linking devices to assets,
+areas, and customers [SURVEY.md §2.1 object model]. This model makes
+that graph a compute object: maintenance risk propagates between devices
+that share an asset or an area (a failing pump stresses its siblings;
+a hot room degrades every device in it).
+
+TPU-first design:
+- **Static shapes throughout** [SURVEY.md §7 hard part d]: nodes padded
+  to a power of two, neighbor lists padded to a fixed fan-in `K`
+  (`max_degree`) with a boolean mask — no dynamic gather sizes, no
+  recompiles as the fleet grows within a capacity bucket.
+- GraphSAGE-style layers: `h' = relu(h·W_self + mean_k(h[nbr])·W_nbr)`.
+  The neighbor aggregation is one `jnp.take` gather + masked mean; the
+  matmuls are bf16 on the MXU, accumulation f32.
+- Fleet-scale sharding: node arrays shard over the mesh `data` axis
+  (`feat/neighbors/mask` with `P("data", ...)`, params replicated); the
+  cross-shard neighbor gather lowers to an XLA all-gather of the layer
+  activations over ICI — the standard node-parallel GNN recipe. See
+  tests/test_gnn.py for the 8-device equivalence check.
+- Supervision: past maintenance alerts (the event store is the label
+  source — predictive maintenance learns from its own incident history).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.models.common import dense_init
+
+
+@dataclass(frozen=True)
+class GnnConfig:
+    feature_dim: int = 10      # must match graph.FEATURE_DIM
+    hidden: int = 64
+    layers: int = 2
+    max_degree: int = 16       # static neighbor fan-in K
+    # column carrying the incident-history label-as-feature (graph.py's
+    # "failed"); it is zeroed on the SELF path so a node's own label can
+    # only reach its prediction through neighbor aggregation — otherwise
+    # training collapses to the shortcut "failed→1" and risk never
+    # propagates to unlabeled siblings. -1 disables the masking.
+    label_feature_col: int = 9
+    compute_dtype: Any = jnp.bfloat16
+
+
+class GnnMaintenanceModel:
+    """Functional message-passing network: params are a pytree; `risk`
+    and `loss` are jit/pjit-friendly (static shapes, no Python state)."""
+
+    name = "gnn"
+
+    def __init__(self, cfg: GnnConfig = GnnConfig()):
+        self.cfg = cfg
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        params: dict = {}
+        keys = jax.random.split(rng, 2 * cfg.layers + 1)
+        d_in = cfg.feature_dim
+        for layer in range(cfg.layers):
+            params[f"self{layer}"] = dense_init(keys[2 * layer], d_in, cfg.hidden)
+            params[f"nbr{layer}"] = dense_init(keys[2 * layer + 1], d_in,
+                                               cfg.hidden)
+            d_in = cfg.hidden
+        params["head"] = dense_init(keys[-1], cfg.hidden, 1)
+        return params
+
+    # -- forward -----------------------------------------------------------
+
+    def _encode(self, params: dict, feat: jax.Array, neighbors: jax.Array,
+                nbr_mask: jax.Array) -> jax.Array:
+        """Message passing → node embeddings [N, hidden]."""
+        cfg = self.cfg
+        cdt = cfg.compute_dtype
+        h = feat.astype(jnp.float32)
+        h_self = (h.at[:, cfg.label_feature_col].set(0.0)
+                  if cfg.label_feature_col >= 0 else h)
+        mask = nbr_mask.astype(jnp.float32)[..., None]        # [N, K, 1]
+        denom = jnp.maximum(mask.sum(1), 1.0)                 # [N, 1]
+        for layer in range(cfg.layers):
+            nbr_h = jnp.take(h, neighbors, axis=0)            # [N, K, D]
+            agg = (nbr_h * mask).sum(1) / denom               # [N, D]
+            ws, wn = params[f"self{layer}"], params[f"nbr{layer}"]
+            z = (h_self.astype(cdt) @ ws["w"].astype(cdt)).astype(jnp.float32) \
+                + (agg.astype(cdt) @ wn["w"].astype(cdt)).astype(jnp.float32) \
+                + ws["b"] + wn["b"]
+            h = jax.nn.relu(z)
+            h_self = h
+        return h
+
+    def risk(self, params: dict, feat: jax.Array, neighbors: jax.Array,
+             nbr_mask: jax.Array) -> jax.Array:
+        """Per-node maintenance risk in [0, 1]. feat: [N, F];
+        neighbors/nbr_mask: [N, K] → [N] float32."""
+        h = self._encode(params, feat, neighbors, nbr_mask)
+        head = params["head"]
+        logits = (h @ head["w"] + head["b"])[..., 0]
+        return jax.nn.sigmoid(logits)
+
+    def logits(self, params: dict, feat: jax.Array, neighbors: jax.Array,
+               nbr_mask: jax.Array) -> jax.Array:
+        h = self._encode(params, feat, neighbors, nbr_mask)
+        head = params["head"]
+        return (h @ head["w"] + head["b"])[..., 0]
+
+    def loss(self, params: dict, feat: jax.Array, neighbors: jax.Array,
+             nbr_mask: jax.Array, labels: jax.Array,
+             label_mask: jax.Array) -> jax.Array:
+        """Masked binary cross-entropy over labeled (device) nodes, with
+        positive-class reweighting (failures are rare)."""
+        logits = self.logits(params, feat, neighbors, nbr_mask)
+        m = label_mask.astype(jnp.float32)
+        y = labels.astype(jnp.float32)
+        n_pos = jnp.maximum((y * m).sum(), 1.0)
+        n_neg = jnp.maximum(((1.0 - y) * m).sum(), 1.0)
+        w = jnp.where(y > 0.5, n_neg / n_pos, 1.0)  # balance classes
+        ce = jnp.maximum(logits, 0) - logits * y + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return (ce * m * w).sum() / jnp.maximum((m * w).sum(), 1.0)

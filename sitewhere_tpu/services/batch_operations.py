@@ -101,6 +101,35 @@ class BatchOperationsEngine(TenantEngine):
         return self._set_status(op.id, BatchOperationStatus.PROCESSING,
                                 started=True)
 
+    async def submit_maintenance_operation(
+            self, *, hidden: int = 32, layers: int = 2, max_degree: int = 16,
+            steps: int = 200, learning_rate: float = 1e-2,
+            window: int = 64, mtype: int = 0,
+            risk_threshold: float = 0.7, emit_alerts: bool = True,
+            feature_dropout: float = 0.3,
+            label_alert_types: Optional[Sequence[str]] = None,
+            alert_type: str = "maintenance.risk") -> BatchOperation:
+        """Fleet predictive-maintenance sweep (config 5 [BASELINE.json]):
+        build the device-asset graph, train the GNN on alert history,
+        score every device, raise maintenance alerts above threshold."""
+        op = BatchOperation(
+            operation_type="maintenance-gnn",
+            parameters={"hidden": hidden, "layers": layers,
+                        "max_degree": max_degree, "steps": steps,
+                        "lr": learning_rate, "window": window,
+                        "mtype": mtype, "risk_threshold": risk_threshold,
+                        "emit_alerts": emit_alerts, "alert_type": alert_type,
+                        "feature_dropout": feature_dropout,
+                        "label_alert_types": (list(label_alert_types)
+                                              if label_alert_types else None)},
+            processing_status=BatchOperationStatus.INITIALIZING)
+        self.spi.create_batch_operation(op)
+        await self.runtime.bus.produce(
+            self.tenant_topic(TopicNaming.BATCH_ELEMENTS),
+            {"operation_id": op.id, "maintenance": True}, key=op.id)
+        return self._set_status(op.id, BatchOperationStatus.PROCESSING,
+                                started=True)
+
     def _set_status(self, op_id: str, status: BatchOperationStatus,
                     started: bool = False, ended: bool = False,
                     result: Optional[dict] = None) -> BatchOperation:
@@ -157,6 +186,8 @@ class BatchElementProcessor(BackgroundTaskComponent):
                     try:
                         if chunk.get("train"):
                             await self._run_training(chunk["operation_id"])
+                        elif chunk.get("maintenance"):
+                            await self._run_maintenance(chunk["operation_id"])
                         else:
                             n = await self._process_command_chunk(chunk)
                             processed.inc(n)
@@ -281,6 +312,96 @@ class BatchElementProcessor(BackgroundTaskComponent):
                 and rule_engine.model_name == model_name:
             rule_engine.swap_model_params(params)
             report["hot_swapped"] = True
+        engine._set_status(op_id, BatchOperationStatus.FINISHED_SUCCESSFULLY,
+                           ended=True, result=report)
+
+    # -- predictive maintenance (config 5) ---------------------------------
+
+    async def _run_maintenance(self, op_id: str) -> None:
+        """Device-asset graph → GNN trained on alert history → per-device
+        risk → maintenance alerts (config 5 [BASELINE.json])."""
+        import numpy as np
+
+        from sitewhere_tpu.domain.batch import AlertBatch, BatchContext
+        from sitewhere_tpu.models.graph import build_fleet_graph
+        from sitewhere_tpu.training.checkpoint import CheckpointStore
+        from sitewhere_tpu.training.maintenance import (
+            MaintenanceTrainer,
+            MaintenanceTrainerConfig,
+            build_maintenance_model,
+        )
+
+        engine = self.engine
+        runtime = engine.runtime
+        tenant_id = engine.tenant_id
+        op = engine.spi.get_batch_operation(op_id)
+        p = op.parameters
+
+        em = await runtime.wait_for_engine("event-management", tenant_id)
+        dm = await runtime.wait_for_engine("device-management", tenant_id)
+
+        # labels = devices with incident history in the event store (the
+        # durable label source). The sweep's own predictions and the
+        # streaming anomaly alerts are NOT incidents — treating them as
+        # ground truth would make every false positive self-reinforcing
+        # (predicted → labeled failed → alerting suppressed forever).
+        label_types = p.get("label_alert_types")
+        failed = set()
+        for alert in em.list_alerts(limit=1_000_000):
+            if label_types is not None:
+                if alert.type not in label_types:
+                    continue
+            elif (alert.type == p["alert_type"]
+                    or alert.type.startswith("anomaly.")):
+                continue
+            device = dm.get_device(alert.device_id)
+            if device is not None and device.index >= 0:
+                failed.add(device.index)
+        graph = build_fleet_graph(
+            dm, em.telemetry, window=p["window"],
+            max_degree=p["max_degree"], mtype=p["mtype"],
+            failed_device_indices=np.asarray(sorted(failed), np.int64))
+
+        model = build_maintenance_model(hidden=p["hidden"],
+                                        layers=p["layers"],
+                                        max_degree=p["max_degree"])
+        trainer = MaintenanceTrainer(model, MaintenanceTrainerConfig(
+            learning_rate=p["lr"], steps=p["steps"],
+            feature_dropout=p.get("feature_dropout", 0.3)))
+        t0 = time.monotonic()
+        params, report = trainer.train(graph)
+        risk = trainer.score(params, graph)
+        report.update({
+            "nodes": graph.n_real, "devices": graph.n_devices,
+            "edges": graph.n_edges, "labeled_failures": len(failed),
+            "train_seconds": round(time.monotonic() - t0, 3),
+            "risk_mean": round(float(risk.mean()), 4) if risk.size else 0.0,
+        })
+
+        store = CheckpointStore(engine.checkpoint_root)
+        report["checkpoint_version"] = store.save(
+            tenant_id, "gnn", params,
+            metadata={"report": {k: v for k, v in report.items()
+                                 if k != "losses"}})
+
+        at_risk = np.nonzero(risk >= p["risk_threshold"])[0]
+        # only *new* predictions are actionable: devices already failed
+        # (labeled) don't need a predictive alert
+        at_risk = np.asarray([i for i in at_risk if i not in failed],
+                             np.int64)
+        report["devices_at_risk"] = int(at_risk.shape[0])
+        if p["emit_alerts"] and at_risk.shape[0]:
+            now = time.time()
+            batch = AlertBatch(
+                ctx=BatchContext(tenant_id=tenant_id, source="maintenance"),
+                device_index=at_risk.astype(np.uint32),
+                level=np.full(at_risk.shape[0], 1, np.uint8),  # WARNING
+                type=[p["alert_type"]] * at_risk.shape[0],
+                message=[f"maintenance risk {risk[i]:.2f} "
+                         f"(gnn sweep {op_id[:8]})" for i in at_risk],
+                ts=np.full(at_risk.shape[0], now),
+                source="model")
+            em.add_alert_batch(batch)
         engine._set_status(op_id, BatchOperationStatus.FINISHED_SUCCESSFULLY,
                            ended=True, result=report)
 

@@ -32,6 +32,11 @@ class SimConfig:
     noise_std: float = 0.15
     anomaly_rate: float = 0.0      # per-event probability of a spike
     anomaly_magnitude: float = 8.0 # added to value (in noise-std units ≫ 1)
+    # degradation drift (predictive-maintenance signal, config 5): a fixed
+    # fraction of devices ramp linearly with time — the trend the GNN's
+    # slope feature picks up long before any threshold rule fires
+    drift_fraction: float = 0.0
+    drift_per_hour: float = 0.0    # units added per hour of sim time
     seed: int = 7
 
 
@@ -51,6 +56,11 @@ class DeviceSimulator:
         self.rng = rng
         self._device_index = np.arange(n, dtype=np.uint32)
         self._mtype = np.zeros(n, dtype=np.uint16)
+        # ground-truth degrading set (fixed per simulator instance); drift
+        # accumulates from the first tick's timestamp, not absolute epoch
+        # time (wall-clock t would make it an instant step, not a ramp)
+        self.drifting = rng.random(n) < cfg.drift_fraction
+        self._drift_t0: float | None = None
 
     def tick(self, t: float | None = None,
              devices: np.ndarray | None = None) -> tuple[MeasurementBatch, np.ndarray]:
@@ -63,6 +73,12 @@ class DeviceSimulator:
                  + self.amp[d] * np.sin(2 * np.pi * (t / self.period[d])
                                         + self.phase[d])
                  + cfg.noise_std * self.rng.standard_normal(d.size).astype(np.float32))
+        if cfg.drift_per_hour:
+            if self._drift_t0 is None:
+                self._drift_t0 = t
+            drifting = self.drifting[d]
+            clean = clean + drifting * (cfg.drift_per_hour
+                                        * (t - self._drift_t0) / 3600.0)
         anomaly = np.zeros(d.size, dtype=bool)
         if cfg.anomaly_rate > 0:
             anomaly = self.rng.random(d.size) < cfg.anomaly_rate

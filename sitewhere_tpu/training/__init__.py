@@ -1,3 +1,10 @@
+from sitewhere_tpu.training.maintenance import (
+    MaintenanceTrainer,
+    MaintenanceTrainerConfig,
+    build_maintenance_model,
+)
 from sitewhere_tpu.training.trainer import Trainer, TrainerConfig, make_windows
 
-__all__ = ["Trainer", "TrainerConfig", "make_windows"]
+__all__ = ["Trainer", "TrainerConfig", "make_windows",
+           "MaintenanceTrainer", "MaintenanceTrainerConfig",
+           "build_maintenance_model"]

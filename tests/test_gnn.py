@@ -1,0 +1,250 @@
+"""Config 5 [BASELINE.json]: GNN predictive maintenance over the
+device-asset graph — graph builder, model numerics, risk propagation
+through shared assets, mesh-sharded equivalence, and the e2e
+batch-operation sweep."""
+
+import numpy as np
+import jax
+
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.batch import AlertBatch, BatchContext
+from sitewhere_tpu.domain.model import (
+    Area,
+    Asset,
+    Device,
+    DeviceAssignment,
+    DeviceType,
+)
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.models.gnn import GnnConfig, GnnMaintenanceModel
+from sitewhere_tpu.models.graph import (
+    FEATURE_DIM,
+    NODE_AREA,
+    NODE_ASSET,
+    NODE_DEVICE,
+    build_fleet_graph,
+)
+from sitewhere_tpu.parallel.mesh import make_mesh
+from sitewhere_tpu.persistence.memory import InMemoryDeviceManagement
+from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+from sitewhere_tpu.training.maintenance import (
+    MaintenanceTrainer,
+    MaintenanceTrainerConfig,
+    build_maintenance_model,
+)
+
+from tests.test_pipeline import wait_until
+
+
+def _fixture_fleet(n_devices=12, n_assets=3, n_areas=2):
+    """Small fleet: devices round-robin across assets; assets' devices
+    grouped into areas; one parent area."""
+    dm = InMemoryDeviceManagement()
+    dt = DeviceType(token="pump", name="Pump")
+    dm.create_device_type(dt)
+    # assets live in asset-management [SURVEY.md §2.2]; the graph builder
+    # only needs their ids via assignments, so bare entities suffice here
+    assets = [Asset(token=f"asset-{i}", name=f"A{i}") for i in range(n_assets)]
+    parent = Area(token="site", name="Site")
+    areas = [parent] + [Area(token=f"area-{i}", name=f"Z{i}",
+                             parent_area_id=parent.id)
+                        for i in range(n_areas)]
+    for ar in areas:
+        dm.create_area(ar)
+    devices = []
+    for i in range(n_devices):
+        d = dm.create_device(Device(token=f"p-{i}", device_type_id=dt.id))
+        asset = assets[i % n_assets]
+        area = areas[1 + (i % n_assets) % n_areas]
+        dm.create_device_assignment(DeviceAssignment(
+            device_id=d.id, asset_id=asset.id, area_id=area.id,
+            token=f"p-{i}-a"))
+        devices.append(d)
+    return dm, devices, assets, areas
+
+
+def _warm_store(n_devices, ticks=40, drift_fraction=0.0, seed=5,
+                drift_per_hour=8.0):
+    store = TelemetryStore(history=64)
+    sim = DeviceSimulator(SimConfig(
+        num_devices=n_devices, seed=seed, drift_fraction=drift_fraction,
+        drift_per_hour=drift_per_hour if drift_fraction else 0.0),
+        tenant_id="t")
+    for k in range(ticks):
+        batch, _ = sim.tick(t=60.0 * k)
+        store.append_measurements(batch)
+    return store, sim
+
+
+def test_graph_builder_topology_and_features():
+    dm, devices, assets, areas = _fixture_fleet(12, 3, 2)
+    store, _ = _warm_store(12)
+    g = build_fleet_graph(dm, store, window=32, max_degree=8)
+
+    assert g.n_devices == 12
+    assert g.n_real == 12 + 3 + 3          # devices + assets + 3 areas
+    assert g.n_pad >= g.n_real and g.n_pad % 8 == 0
+    assert g.node_feat.shape == (g.n_pad, FEATURE_DIM)
+    # every device node: asset edge + area edge
+    assert (g.nbr_mask[:12].sum(1) == 2).all()
+    assert (g.node_type[:12] == NODE_DEVICE).all()
+    assert (g.node_type[12:15] == NODE_ASSET).all()
+    assert (g.node_type[15:18] == NODE_AREA).all()
+    # asset degree: 4 devices each (12 / 3)
+    assert (g.nbr_mask[12:15].sum(1) == 4).all()
+    # undirected symmetry: device 0's asset neighbor lists device 0 back
+    a0 = g.neighbors[0, 0]
+    assert 0 in g.neighbors[a0][g.nbr_mask[a0]]
+    # padding rows are inert
+    assert not g.nbr_mask[g.n_real:].any()
+    assert (g.node_feat[g.n_real:] == 0).all()
+    # labels cover device nodes only
+    assert g.label_mask[:12].all() and not g.label_mask[12:].any()
+
+
+def test_graph_features_pick_up_drift():
+    store, sim = _warm_store(32, ticks=50, drift_fraction=0.3)
+    from sitewhere_tpu.models.graph import device_features
+
+    feats = device_features(store, 32, window=48)
+    slopes = feats[:, 3]
+    # signed means: the sine's local slopes average out across random
+    # phases, the degradation drift does not
+    assert slopes[sim.drifting].mean() > slopes[~sim.drifting].mean() + 2.0
+
+
+def test_gnn_loss_decreases_and_risk_orders():
+    """Train on a fleet where one asset's devices fail; the unlabeled
+    device sharing that asset must score above devices on healthy
+    assets (risk propagation through the graph)."""
+    dm, devices, assets, areas = _fixture_fleet(12, 3, 2)
+    store, _ = _warm_store(12)
+    # devices 0,3,6 are on asset 0; 9 also on asset 0 but unlabeled
+    failed = np.asarray([0, 3, 6])
+    g = build_fleet_graph(dm, store, window=32, max_degree=8,
+                          failed_device_indices=failed)
+    # neutralize telemetry features: only graph structure should matter
+    g.node_feat[:12, :5] = 0.0
+
+    model = build_maintenance_model(hidden=16, layers=2, max_degree=8)
+    trainer = MaintenanceTrainer(model, MaintenanceTrainerConfig(
+        learning_rate=3e-2, steps=150, seed=1))
+    params, report = trainer.train(g)
+    assert report["losses"][-1] < report["losses"][0]
+
+    risk = trainer.score(params, g)
+    on_failed_asset = 9          # shares asset 0 with the failed devices
+    healthy = [1, 2, 4, 5, 7, 8, 10, 11]  # devices on assets 1 and 2
+    assert risk[on_failed_asset] > max(risk[d] for d in healthy)
+
+
+def test_gnn_sharded_inference_matches_single_device():
+    dm, *_ = _fixture_fleet(24, 4, 2)
+    store, _ = _warm_store(24)
+    g = build_fleet_graph(dm, store, window=32, max_degree=8)
+    model = build_maintenance_model(hidden=16, layers=2, max_degree=8)
+    params = model.init(jax.random.PRNGKey(0))
+
+    plain = MaintenanceTrainer(model)
+    sharded = MaintenanceTrainer(model, mesh=make_mesh(data=8, model=1))
+    r1 = plain.score(params, g)
+    r2 = sharded.score(params, g)
+    np.testing.assert_allclose(r1, r2, rtol=2e-4, atol=1e-5)
+
+
+def test_e2e_maintenance_sweep_batch_operation(run):
+    """Full config-5 slice in the service runtime: alert history labels →
+    graph → GNN sweep → maintenance alerts + checkpoint."""
+    import tempfile
+
+    from sitewhere_tpu.services import (
+        BatchOperationsService,
+        DeviceManagementService,
+        DeviceStateService,
+        EventManagementService,
+        EventSourcesService,
+        InboundProcessingService,
+    )
+
+    async def main():
+        with tempfile.TemporaryDirectory() as ckpt_root:
+            rt = ServiceRuntime(InstanceSettings(instance_id="maint"))
+            for cls in (DeviceManagementService, EventSourcesService,
+                        InboundProcessingService, EventManagementService,
+                        DeviceStateService, BatchOperationsService):
+                rt.add_service(cls(rt))
+            await rt.start()
+            await rt.add_tenant(TenantConfig(
+                tenant_id="acme",
+                sections={"batch-operations": {"checkpoint_root": ckpt_root},
+                          "event-management": {"history": 64}}))
+            dm = rt.api("device-management").management("acme")
+            dt = DeviceType(token="pump", name="Pump")
+            # 3 assets × 8 devices
+            dm.spi.create_device_type(dt)
+            assets = [Asset(token=f"as-{i}", name=f"A{i}") for i in range(3)]
+            for i in range(24):
+                d = dm.spi.create_device(Device(
+                    token=f"p-{i}", device_type_id=dt.id))
+                dm.spi.create_device_assignment(DeviceAssignment(
+                    device_id=d.id, asset_id=assets[i % 3].id,
+                    token=f"p-{i}-a"))
+
+            em = rt.api("event-management").management("acme")
+            # asset-0's devices degrade (drift) — the telemetry signal
+            # that accompanies the incident history
+            sim = DeviceSimulator(SimConfig(num_devices=24, seed=9,
+                                            drift_per_hour=6.0),
+                                  tenant_id="acme")
+            sim.drifting = np.arange(24) % 3 == 0
+            for k in range(40):
+                batch, _ = sim.tick(t=60.0 * k)
+                em.telemetry.append_measurements(batch)
+
+            # incident history: 5 of the 8 asset-0 devices have failed;
+            # 15, 18, 21 are the unlabeled siblings the sweep must flag
+            failed = np.asarray([0, 3, 6, 9, 12], np.uint32)
+            em.add_alert_batch(AlertBatch(
+                ctx=BatchContext(tenant_id="acme", source="test"),
+                device_index=failed,
+                level=np.full(failed.shape[0], 2, np.uint8),
+                type=["hardware.failure"] * failed.shape[0],
+                message=["failed"] * failed.shape[0],
+                ts=np.full(failed.shape[0], 2400.0), source="device"))
+
+            ops = rt.api("batch-operations").operations("acme")
+            op = await ops.submit_maintenance_operation(
+                hidden=16, layers=2, max_degree=8, steps=200,
+                learning_rate=3e-2, window=32, risk_threshold=0.5,
+                feature_dropout=0.5)
+            done = await ops.wait_for_operation(op.id, timeout=120.0)
+            result = done.parameters["result"]
+            assert result["devices"] == 24
+            assert result["labeled_failures"] == 5
+            assert result["edges"] == 24
+            assert result["checkpoint_version"] == 1
+            # asset-0's unlabeled siblings predicted at risk → new alerts
+            maint = [a for a in em.list_alerts(limit=10_000)
+                     if a.type == "maintenance.risk"]
+            assert result["devices_at_risk"] == len(maint)
+            at_risk_idx = {dm.get_device(a.device_id).index for a in maint}
+            # the unlabeled asset-0 siblings are flagged...
+            assert {15, 18, 21} <= at_risk_idx, at_risk_idx
+            # ...no already-failed device is re-alerted, and no device on
+            # a healthy asset is dragged in
+            assert not (at_risk_idx & set(failed.tolist()))
+            assert all(i % 3 == 0 for i in at_risk_idx), at_risk_idx
+
+            # second sweep: the first sweep's own maintenance.risk alerts
+            # must NOT become training labels (self-reinforcement loop)
+            op2 = await ops.submit_maintenance_operation(
+                hidden=16, layers=2, max_degree=8, steps=50,
+                learning_rate=3e-2, window=32, risk_threshold=0.5,
+                feature_dropout=0.5)
+            done2 = await ops.wait_for_operation(op2.id, timeout=120.0)
+            assert done2.parameters["result"]["labeled_failures"] == 5
+            assert done2.parameters["result"]["checkpoint_version"] == 2
+            await rt.stop()
+
+    run(main())
