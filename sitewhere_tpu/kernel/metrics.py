@@ -93,16 +93,27 @@ class Histogram:
         if m > self._max:
             self._max = m
 
+    def reset(self) -> None:
+        """Zero the counts (bench phase boundaries)."""
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self._max = 0.0
+
     def quantile(self, q: float) -> float:
-        """Upper-bound estimate of the q-quantile from bucket counts."""
+        """Estimate of the q-quantile: linear interpolation within the
+        bucket that crosses the target rank (upper-bounded by `_max`)."""
         if self.count == 0:
             return 0.0
         target = math.ceil(q * self.count)
         seen = 0
         for i, c in enumerate(self.counts):
+            if seen + c >= target:
+                hi = self.buckets[i] if i < len(self.buckets) else self._max
+                lo = self.buckets[i - 1] if 0 < i <= len(self.buckets) else 0.0
+                frac = (target - seen) / c if c else 1.0
+                return min(lo + frac * (hi - lo), self._max)
             seen += c
-            if seen >= target:
-                return self.buckets[i] if i < len(self.buckets) else self._max
         return self._max
 
     @property

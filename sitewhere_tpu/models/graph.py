@@ -63,12 +63,13 @@ class FleetGraph:
         return self.node_feat, self.neighbors, self.nbr_mask
 
 
+from sitewhere_tpu.utils import grow_pow2
+
+
 def _pad_to(n: int, multiple: int) -> int:
-    """Next power of two ≥ n that is also a multiple of `multiple`."""
-    p = max(multiple, 1)
-    while p < n:
-        p *= 2
-    return ((p + multiple - 1) // multiple) * multiple
+    """Next power of two ≥ n that is also a multiple of `multiple`
+    (shared growth policy, utils/capacity.py)."""
+    return grow_pow2(n, multiple=multiple)
 
 
 def device_features(telemetry: TelemetryStore, n_devices: int,

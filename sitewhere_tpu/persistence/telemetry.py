@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from sitewhere_tpu.domain.batch import LocationBatch, MeasurementBatch
+from sitewhere_tpu.utils import grow_pow2
 
 
 class TelemetryTable:
@@ -43,9 +44,7 @@ class TelemetryTable:
     def _ensure_capacity(self, max_index: int) -> None:
         if max_index < self.capacity:
             return
-        new_cap = self.capacity
-        while new_cap <= max_index:
-            new_cap *= 2
+        new_cap = grow_pow2(max_index + 1, floor=self.capacity * 2)
         for name in ("values", "ts"):
             old = getattr(self, name)
             grown = np.zeros((new_cap, self.history), old.dtype)
@@ -119,9 +118,7 @@ class LocationTable:
     def _ensure_capacity(self, max_index: int) -> None:
         if max_index < self.capacity:
             return
-        new_cap = self.capacity
-        while new_cap <= max_index:
-            new_cap *= 2
+        new_cap = grow_pow2(max_index + 1, floor=self.capacity * 2)
         for name in ("lat", "lon", "elev", "ts"):
             old = getattr(self, name)
             grown = np.zeros((new_cap, self.history), old.dtype)

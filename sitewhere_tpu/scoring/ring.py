@@ -1,0 +1,172 @@
+"""Device-resident telemetry ring: per-device history in TPU HBM.
+
+The TPU-first answer to SURVEY.md §7 hard part (a). The host→device link
+is the scarce resource (over a tunneled chip it is ~66 ms per host sync,
+size-independent up to ~256 KB; on local hardware it is PCIe — either
+way, bytes and syncs are what cost). So the hot scoring path never ships
+windows: per-device history lives on device as a ring `[capacity+1,
+window]` (row `capacity` is a scratch row that absorbs padding writes),
+and ONE jit fuses
+
+    scatter (append new values) → gather (per-device window) → model.score
+
+so a flush transfers only the deltas — device ids (int32) + values
+(float32), 8 bytes/event — and returns the scores. State buffers are
+donated, so XLA updates the ring in place with no on-device copies.
+
+The host-side columnar `TelemetryStore` (persistence/telemetry.py) stays
+the durable query/training copy; `load()` re-syncs the ring from it at
+warmup or after a dispatch fault.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.utils import grow_pow2
+
+
+class DeviceRing:
+    """Ring of one scalar channel for up to `capacity` devices, resident
+    on `device` (default backend device)."""
+
+    def __init__(self, window: int, capacity: int = 1024,
+                 initial_floor: int = 1024):
+        self.window = int(window)
+        self.capacity = grow_pow2(int(capacity), floor=initial_floor)
+        self._update_score_fns: dict[tuple, Callable] = {}
+        self._update_fns: dict[tuple, Callable] = {}
+        self.faulted = False  # True after a failed dispatch donated state away
+        self._alloc(self.capacity)
+
+    # -- state -------------------------------------------------------------
+
+    def _alloc(self, cap: int) -> None:
+        w = self.window
+        self.values = jnp.zeros((cap + 1, w), jnp.float32)
+        self.count = jnp.zeros(cap + 1, jnp.int32)
+        self.cursor = jnp.zeros(cap + 1, jnp.int32)
+
+    def ensure_capacity(self, max_index: int) -> None:
+        """Grow (device-side) so `max_index` is a valid device row."""
+        if max_index < self.capacity:
+            return
+        new_cap = grow_pow2(max_index + 1, floor=self.capacity * 2)
+        grow = new_cap - self.capacity
+        # drop the old scratch row (its contents are garbage), zero-extend,
+        # append a fresh scratch row
+        self.values = jnp.pad(self.values[:-1], ((0, grow + 1), (0, 0)))
+        self.count = jnp.pad(self.count[:-1], (0, grow + 1))
+        self.cursor = jnp.pad(self.cursor[:-1], (0, grow + 1))
+        self.capacity = new_cap
+
+    def load(self, values: np.ndarray, count: np.ndarray,
+             start: int = 0) -> None:
+        """Overwrite rows `start..start+n` from host window data.
+
+        `values[n, window]` is chronological with left padding (the
+        `TelemetryStore.window` layout); `count[n]` is valid entries per
+        row. Ring form places the valid suffix at positions `0..count-1`
+        with the cursor pointing at the next slot.
+        """
+        n, w = values.shape
+        assert w == self.window
+        self.ensure_capacity(start + n - 1 if n else 0)
+        cnt = np.minimum(count.astype(np.int32), w)
+        # shift each row left by (w - cnt) so valid data sits at 0..cnt-1
+        idx = (np.arange(w)[None, :] + (w - cnt)[:, None]) % w
+        ring_rows = np.take_along_axis(values.astype(np.float32), idx, axis=1)
+        self.values = self.values.at[start:start + n].set(ring_rows)
+        self.count = self.count.at[start:start + n].set(cnt)
+        self.cursor = self.cursor.at[start:start + n].set(cnt % w)
+        self.faulted = False
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _build_update_score(self, model, cap: int, bucket: int) -> Callable:
+        w = self.window
+
+        def step(params, vals, cnt, cur, dev, v):
+            pos = cur[dev]
+            vals = vals.at[dev, pos].set(v, mode="drop")
+            cur = cur.at[dev].set((pos + 1) % w, mode="drop")
+            cnt = jnp.minimum(cnt.at[dev].add(1, mode="drop"), w)
+            idx = (cur[dev][:, None] - w + jnp.arange(w)[None, :]) % w
+            x = vals[dev[:, None], idx]
+            valid = jnp.arange(w)[None, :] >= (w - cnt[dev])[:, None]
+            return vals, cnt, cur, model.score(params, x, valid)
+
+        return jax.jit(step, donate_argnums=(1, 2, 3))
+
+    def _build_update(self, cap: int, bucket: int) -> Callable:
+        w = self.window
+
+        def step(vals, cnt, cur, dev, v):
+            pos = cur[dev]
+            vals = vals.at[dev, pos].set(v, mode="drop")
+            cur = cur.at[dev].set((pos + 1) % w, mode="drop")
+            cnt = jnp.minimum(cnt.at[dev].add(1, mode="drop"), w)
+            return vals, cnt, cur
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _pad(self, dev: np.ndarray, v: np.ndarray,
+             bucket: int) -> tuple[np.ndarray, np.ndarray]:
+        n = dev.shape[0]
+        out_dev = np.full(bucket, self.capacity, np.int32)  # scratch row
+        out_v = np.zeros(bucket, np.float32)
+        out_dev[:n] = dev
+        out_v[:n] = v
+        return out_dev, out_v
+
+    def update_and_score(self, model, params, dev: np.ndarray,
+                         v: np.ndarray, bucket: int) -> jax.Array:
+        """Append `v[i]` to ring row `dev[i]` (unique ids!), score every
+        touched device's window; returns `[bucket]` scores on device
+        (async — caller settles off-loop)."""
+        key = (self.capacity, bucket)
+        fn = self._update_score_fns.get(key)
+        if fn is None:
+            fn = self._update_score_fns[key] = \
+                self._build_update_score(model, self.capacity, bucket)
+        pdev, pv = self._pad(dev, v, bucket)
+        try:
+            self.values, self.count, self.cursor, scores = fn(
+                params, self.values, self.count, self.cursor, pdev, pv)
+        except Exception:
+            self.faulted = True  # donated state is gone; needs load()
+            raise
+        return scores
+
+    def update(self, dev: np.ndarray, v: np.ndarray, bucket: int) -> None:
+        """Append-only step (used for all-but-last occurrences when one
+        flush carries several events for the same device)."""
+        key = (self.capacity, bucket)
+        fn = self._update_fns.get(key)
+        if fn is None:
+            fn = self._update_fns[key] = self._build_update(self.capacity, bucket)
+        pdev, pv = self._pad(dev, v, bucket)
+        try:
+            self.values, self.count, self.cursor = fn(
+                self.values, self.count, self.cursor, pdev, pv)
+        except Exception:
+            self.faulted = True
+            raise
+
+    def windows(self, dev: np.ndarray) -> tuple[jax.Array, jax.Array]:
+        """Device-resident (x, valid) windows for `dev` — the query path
+        (training snapshots use the host store instead)."""
+        w = self.window
+        d = jnp.asarray(dev.astype(np.int32))
+        idx = (self.cursor[d][:, None] - w + jnp.arange(w)[None, :]) % w
+        x = self.values[d[:, None], idx]
+        valid = jnp.arange(w)[None, :] >= (w - self.count[d])[:, None]
+        return x, valid
+
+    def close(self) -> None:
+        self._update_score_fns.clear()
+        self._update_fns.clear()

@@ -1,4 +1,4 @@
-"""The TPU scoring server: batched, bucketed, async model inference.
+"""The TPU scoring server: batched, bucketed, pipelined model inference.
 
 This is the component the judge's metric lives on [BASELINE.json
 north_star: ≥1M events/s scored at p99 < 10 ms on v5e-8]. It replaces the
@@ -10,36 +10,48 @@ hard parts called out in SURVEY.md §7:
     - admission batching with a deadline: events accumulate for at most
       `batch_window_ms` (or until a full bucket) before a flush;
     - pre-compiled fixed shapes: batch sizes are padded up to a small set
-      of buckets, each jit-compiled at startup (`warmup()`), so no
-      request ever pays a compile;
-    - chunks are software-pipelined: dispatch chunk k, gather chunk k+1
-      on the host while the TPU runs k, then read k back with a short
-      synchronous block (measured: cooperative is_ready polling loses
-      >100ms/chunk to event-loop requeueing under flood; a ~2ms block
-      is the right trade).
-(b) per-tenant model multiplexing without recompiles → `score_fn` is
-    built once per (model, bucket); stacked-params tenant batching plugs
-    in via the same bucket machinery (parallel/tenant_stack.py).
+      of buckets, each jit-compiled at warmup, so no request pays a
+      compile;
+    - device-resident history: per-device windows live in TPU HBM
+      (scoring/ring.py); a flush uploads only (device id, value) deltas
+      — 8 bytes/event — and ONE fused XLA call appends + gathers +
+      scores. No host-side window materialization on the hot path.
+    - pipelined settle: dispatch is async; a small thread pool reads
+      results back (host syncs are ~66 ms over a tunneled chip but
+      parallelize and don't block dispatch), then delivery runs on the
+      event loop via the session's `sink`. Throughput is dispatch-bound,
+      not round-trip-bound.
+(b) per-tenant model multiplexing without recompiles → stacked-params
+    tenant batching via the same bucket machinery (scoring/pool.py).
 
-Scoring input is the device's recent telemetry window gathered from the
-columnar store (`TelemetryStore.window` — one numpy gather), so scoring
-needs no per-event state of its own.
+`score_devices` (the query/test path) still gathers windows from the
+host `TelemetryStore`; only admit/flush — the hot path — uses the ring.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
-from dataclasses import dataclass, field
-from typing import Callable, Optional
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch, ScoredBatch
 from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.scoring.ring import DeviceRing
+
+logger = logging.getLogger(__name__)
+
+Sink = Callable[[ScoredBatch], Awaitable[None]]
+
+# Settle threads are shared across sessions/tenants: each readback holds a
+# worker for one link round trip; readbacks parallelize across threads.
+_SETTLE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="swx-settle")
 
 
 @dataclass(frozen=True)
@@ -49,28 +61,37 @@ class ScoringConfig:
     threshold: float = 4.0          # z-like score ⇒ alert
     mtype: int = 0                  # channel scored
     seed: int = 0
+    max_inflight: int = 64          # dispatched-not-settled flush bound
+    capacity: int = 0               # fleet-size hint: pre-size the ring
 
 
 class ScoringSession:
-    """One tenant's scorer: model + device-resident params + bucketed
-    compiled functions + admission queue."""
+    """One tenant's scorer: model + device-resident params & history ring
+    + bucketed compiled functions + admission queue."""
 
     def __init__(self, model, telemetry: TelemetryStore,
                  metrics: MetricsRegistry, cfg: ScoringConfig = ScoringConfig(),
-                 params: Optional[dict] = None):
+                 params: Optional[dict] = None, sink: Optional[Sink] = None):
         self.model = model
         self.telemetry = telemetry
         self.cfg = cfg
+        self.sink = sink
         self.params = jax.device_put(
             params if params is not None
             else model.init(jax.random.PRNGKey(cfg.seed)))
         self.version = 0
-        self._fns: dict[int, Callable] = {}
-        # False while background warmup compiles buckets; flushes are held
-        # (admission capped) so no live request pays a compile
+        w = model.cfg.window
+        host = telemetry.channels.get(cfg.mtype)
+        self.ring = DeviceRing(w, capacity=max(
+            cfg.capacity, host.capacity if host else 0, 1024))
+        self._fns: dict[int, Callable] = {}   # score_devices query path
+        # False while warmup compiles buckets; flushes are held (admission
+        # capped) so no live request pays a compile
         self.ready = True
+        self.inflight = 0
         # pending admission state
-        self._pending: list[tuple[np.ndarray, np.ndarray, np.ndarray, BatchContext]] = []
+        self._pending: list[tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray, BatchContext]] = []
         self._pending_n = 0
         self._deadline: Optional[float] = None
         # metrics (judge's metrics are first-class [SURVEY.md §5.5])
@@ -80,8 +101,69 @@ class ScoringSession:
         self.batch_size_hist = metrics.histogram(
             "scoring.batch_size", buckets=[float(b) for b in cfg.buckets])
         self.anomalies = metrics.counter("scoring.anomalies_detected")
+        self.dropped = metrics.counter("scoring.admissions_dropped")
+        self.sink_failures = metrics.counter("scoring.sink_failures")
 
-    # -- compiled functions ------------------------------------------------
+    # -- warmup / params ---------------------------------------------------
+
+    def _warm_dispatches(self):
+        """Yield one (bucket-compile) device result per call round: the
+        fused hot path, the append-only step (duplicate rounds), and the
+        host-window query path all get their buckets precompiled."""
+        import jax.numpy as jnp
+
+        w = self.model.cfg.window
+        dev = np.empty(0, np.int32)
+        v = np.empty(0, np.float32)
+        for b in self.cfg.buckets:
+            yield self.ring.update_and_score(self.model, self.params, dev, v, b)
+            self.ring.update(dev, v, b)
+            yield self._fn(b)(self.params, jnp.zeros((b, w), jnp.float32),
+                              jnp.ones((b, w), jnp.bool_))
+
+    def warmup(self) -> None:
+        """Synchronous warmup: seed the ring from the host store (adopting
+        its device capacity, so bucket compiles happen at the live shape),
+        then compile every bucket (tests / tools)."""
+        self._load_ring()
+        for out in self._warm_dispatches():
+            out.block_until_ready()
+        self.ready = True
+
+    async def warmup_async(self) -> None:
+        """Background warmup: compiles block the loop (first TPU compile
+        can be tens of seconds over a tunnel), but services are already
+        started and admission is capped meanwhile."""
+        self.ready = False
+        self._load_ring()
+        for out in self._warm_dispatches():
+            while not out.is_ready():
+                await asyncio.sleep(0.01)
+        self.ready = True
+
+    def _load_ring(self) -> None:
+        """Seed/repair the device ring from the host store (one bulk
+        upload; uploads are bandwidth-cheap, it's *syncs* that cost)."""
+        host = self.telemetry.channels.get(self.cfg.mtype)
+        if host is None:
+            return
+        w = self.model.cfg.window
+        devices = np.arange(host.capacity)
+        x, _ = host.window(devices, w)
+        self.ring.load(x, np.minimum(host.count, w))
+
+    def reload_history(self) -> None:
+        """Re-sync the device ring from the host store (bulk-import path:
+        history that entered the store without passing through admit)."""
+        self._load_ring()
+
+    def swap_params(self, new_params: dict) -> int:
+        """Hot-swap trained params (checkpoint rollout); bumps version."""
+        self.params = jax.device_put(new_params)
+        self.version += 1
+        return self.version
+
+    # -- query-path scoring (host windows; not the hot path) ---------------
 
     def _fn(self, bucket: int) -> Callable:
         fn = self._fns.get(bucket)
@@ -90,38 +172,6 @@ class ScoringSession:
             fn = jax.jit(lambda p, x, v: model.score(p, x, v))
             self._fns[bucket] = fn
         return fn
-
-    def warmup(self) -> None:
-        """Pre-compile every bucket so no live request pays a compile
-        (SURVEY.md §7 hard part a)."""
-        w = self.model.cfg.window
-        for b in self.cfg.buckets:
-            x = jnp.zeros((b, w), jnp.float32)
-            v = jnp.ones((b, w), jnp.bool_)
-            self._fn(b)(self.params, x, v).block_until_ready()
-        self.ready = True
-
-    async def warmup_async(self) -> None:
-        """Background warmup: one bucket per loop visit. Compiles block the
-        loop (first TPU compile can be tens of seconds over a tunnel), but
-        services are already started and admission is capped meanwhile."""
-        self.ready = False
-        w = self.model.cfg.window
-        for b in self.cfg.buckets:
-            x = jnp.zeros((b, w), jnp.float32)
-            v = jnp.ones((b, w), jnp.bool_)
-            out = self._fn(b)(self.params, x, v)
-            while not out.is_ready():
-                await asyncio.sleep(0.01)
-        self.ready = True
-
-    def swap_params(self, new_params: dict) -> int:
-        """Hot-swap trained params (checkpoint rollout); bumps version."""
-        self.params = jax.device_put(new_params)
-        self.version += 1
-        return self.version
-
-    # -- scoring -----------------------------------------------------------
 
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.buckets:
@@ -132,23 +182,18 @@ class ScoringSession:
     async def score_devices(self, devices: np.ndarray, ts: np.ndarray,
                             ingest_mono: np.ndarray,
                             ctx: BatchContext) -> ScoredBatch:
-        """Score a set of events (by device window); returns ScoredBatch.
+        """Score a set of devices from their *host-store* windows.
 
-        Large inputs are chunked to the max bucket; each chunk is padded
-        to its bucket, dispatched async, and read back off-loop.
-        """
+        The query/REST/test path: gathers `[D, W]` on host and ships it.
+        Chunks are dispatched back-to-back and settled concurrently off
+        the event loop."""
         if devices.shape[0] == 0:
             return ScoredBatch(ctx, devices, np.zeros(0, np.float32),
                                np.zeros(0, bool), ts, self.version)
         w = self.model.cfg.window
         max_b = self.cfg.buckets[-1]
-        outs: list[np.ndarray] = []
-        # Software pipelining: dispatch chunk k, gather chunk k+1 on the
-        # host while the TPU runs k, then read k back with a *synchronous*
-        # bounded block. Under flood, a cooperative is_ready poll loses
-        # 100ms+ per chunk to event-loop requeueing (measured) while the
-        # actual TPU time is ~1.5ms — a short block is the right trade.
-        prev: Optional[tuple] = None  # (scores_dev, n)
+        loop = asyncio.get_running_loop()
+        settles = []
         for lo in range(0, devices.shape[0], max_b):
             chunk = devices[lo:lo + max_b]
             n = chunk.shape[0]
@@ -159,16 +204,10 @@ class ScoringSession:
                 x = np.concatenate([x, np.zeros((pad, w), np.float32)])
                 valid = np.concatenate([valid, np.zeros((pad, w), bool)])
             scores_dev = self._fn(bucket)(self.params, x, valid)
-            try:
-                scores_dev.copy_to_host_async()
-            except Exception:  # not all backends support the prefetch hint
-                pass
-            if prev is not None:
-                outs.append(np.asarray(prev[0])[: prev[1]])
-            prev = (scores_dev, n)
             self.batch_size_hist.observe(float(n))
-            await asyncio.sleep(0)  # let the pipeline breathe between chunks
-        outs.append(np.asarray(prev[0])[: prev[1]])
+            settles.append((loop.run_in_executor(
+                _SETTLE_POOL, np.asarray, scores_dev), n))
+        outs = [(await fut)[:n] for fut, n in settles]
         scores = np.concatenate(outs) if len(outs) > 1 else outs[0]
         now = time.monotonic()
         self.scored_meter.mark(devices.shape[0])
@@ -180,17 +219,20 @@ class ScoringSession:
         return ScoredBatch(ctx, devices, scores.astype(np.float32),
                            is_anom, ts, model_version=self.version)
 
-    # -- admission batching ------------------------------------------------
+    # -- admission batching (the hot path) ---------------------------------
 
     def admit(self, batch: MeasurementBatch) -> None:
         """Queue a measurement batch for the next flush."""
         mask = batch.mtype == self.cfg.mtype
-        dev = batch.device_index if mask.all() else batch.device_index[mask]
-        ts = batch.ts if mask.all() else batch.ts[mask]
+        if mask.all():
+            dev, val, ts = batch.device_index, batch.value, batch.ts
+        else:
+            dev, val, ts = (batch.device_index[mask], batch.value[mask],
+                            batch.ts[mask])
         if dev.shape[0] == 0:
             return
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
-        self._pending.append((dev, ts, ingest, batch.ctx))
+        self._pending.append((dev, val, ts, ingest, batch.ctx))
         self._pending_n += dev.shape[0]
         if self._deadline is None:
             self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
@@ -199,11 +241,21 @@ class ScoringSession:
         while not self.ready and self._pending_n > cap and len(self._pending) > 1:
             old = self._pending.pop(0)
             self._pending_n -= old[0].shape[0]
+            self.dropped.inc(old[0].shape[0])
+
+    @property
+    def idle(self) -> bool:
+        """Nothing admitted, dispatched, or awaiting sink delivery — the
+        consumer's commit gate (at-least-once: offsets commit only when
+        every consumed event's scored output has been published)."""
+        return self._pending_n == 0 and self.inflight == 0
 
     @property
     def flush_due(self) -> bool:
         if self._pending_n == 0 or not self.ready:
             return False
+        if self.inflight >= self.cfg.max_inflight:
+            return False  # backpressure: let settles catch up
         return (self._pending_n >= self.cfg.buckets[-1]
                 or time.monotonic() >= (self._deadline or 0.0))
 
@@ -216,25 +268,174 @@ class ScoringSession:
         busy-looping at the window period."""
         if self._pending_n == 0 or not self.ready:
             return 0.2
+        if self.inflight >= self.cfg.max_inflight:
+            return 0.005
         return max((self._deadline or 0.0) - time.monotonic(), 0.0)
 
-    async def flush(self) -> Optional[ScoredBatch]:
-        if self._pending_n == 0:
-            return None
+    def _take_pending(self):
         pending, self._pending = self._pending, []
         self._pending_n, self._deadline = 0, None
         dev = np.concatenate([p[0] for p in pending])
-        ts = np.concatenate([p[1] for p in pending])
-        ingest = np.concatenate([p[2] for p in pending])
-        # merged context: keep the earliest ingest stamp; name all sources
-        sources = {p[3].source for p in pending}
-        ctx = pending[0][3] if len(sources) == 1 else BatchContext(
-            tenant_id=pending[0][3].tenant_id, source="+".join(sorted(sources)),
-            ingest_monotonic=min(p[3].ingest_monotonic for p in pending))
-        t0 = time.monotonic()
-        scored = await self.score_devices(dev, ts, ingest, ctx)
-        self.batch_latency.observe(time.monotonic() - t0)
-        return scored
+        val = np.concatenate([p[1] for p in pending]).astype(np.float32, copy=False)
+        ts = np.concatenate([p[2] for p in pending])
+        ingest = np.concatenate([p[3] for p in pending])
+        sources = {p[4].source for p in pending}
+        ctx = pending[0][4] if len(sources) == 1 else BatchContext(
+            tenant_id=pending[0][4].tenant_id, source="+".join(sorted(sources)),
+            ingest_monotonic=min(p[4].ingest_monotonic for p in pending))
+        return dev, val, ts, ingest, ctx
+
+    def _dispatch(self, dev, val):
+        """Append + score on device; returns (scores_dev, uniq_dev,
+        inverse) where scores_dev[:len(uniq_dev)] are per-device scores.
+
+        When a flush carries several events for one device, earlier
+        occurrences are applied with append-only steps (in arrival
+        order); the fused scoring step runs on the final occurrences, so
+        every event's score reflects the device's newest window."""
+        dev = dev.astype(np.int32, copy=False)
+        self.ring.ensure_capacity(int(dev.max()))
+        uniq, inverse, counts = np.unique(dev, return_inverse=True,
+                                          return_counts=True)
+        if counts.max() > 1:
+            order = np.argsort(dev, kind="stable")
+            sd, sv = dev[order], val[order]
+            _, start, cnts = np.unique(sd, return_index=True, return_counts=True)
+            cum = np.arange(dev.shape[0]) - np.repeat(start, cnts)
+            last = cum == np.repeat(cnts - 1, cnts)
+            for r in range(int(cum[~last].max()) + 1 if (~last).any() else 0):
+                sel = (cum == r) & ~last
+                if sel.any():
+                    sub_d, sub_v = sd[sel], sv[sel]
+                    self.ring.update(sub_d, sub_v,
+                                     self._bucket_for(sub_d.shape[0]))
+            dev_final, val_final = sd[last], sv[last]
+        else:
+            # no duplicates: score the batch as-is, identity mapping
+            dev_final, val_final = dev, val
+            uniq = dev
+            inverse = np.arange(dev.shape[0])
+        bucket = self._bucket_for(dev_final.shape[0])
+        scores_dev = self.ring.update_and_score(
+            self.model, self.params, dev_final, val_final, bucket)
+        self.batch_size_hist.observe(float(dev_final.shape[0]))
+        return scores_dev, uniq, inverse
+
+    async def _settle_and_deliver(self, scores_dev, uniq, inverse, dev, ts,
+                                  ingest, ctx, t0: float,
+                                  fut: Optional[asyncio.Future] = None):
+        # inflight covers settle AND sink delivery: drain()/the consumer
+        # commit gate must not consider a flush done until its scored
+        # output has been published
+        loop = asyncio.get_running_loop()
+        try:
+            try:
+                scores_u = await loop.run_in_executor(_SETTLE_POOL, np.asarray,
+                                                      scores_dev)
+            except BaseException as exc:
+                if fut is not None and not fut.done():
+                    fut.set_exception(exc if isinstance(exc, Exception)
+                                      else RuntimeError("settle cancelled"))
+                if isinstance(exc, Exception):
+                    logger.exception("scoring settle failed")
+                    return
+                raise
+            scores = scores_u[:uniq.shape[0]][inverse].astype(np.float32)
+            now = time.monotonic()
+            self.scored_meter.mark(dev.shape[0])
+            self.latency.observe_array(now - ingest)
+            self.batch_latency.observe(now - t0)
+            is_anom = scores >= self.cfg.threshold
+            n_anom = int(is_anom.sum())
+            if n_anom:
+                self.anomalies.inc(n_anom)
+            scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
+                                 model_version=self.version)
+            if fut is not None and not fut.done():
+                fut.set_result(scored)
+            if self.sink is not None:
+                try:
+                    await self.sink(scored)
+                except Exception:  # noqa: BLE001 - sink errors can't kill settles
+                    self.sink_failures.inc()
+                    logger.exception("scoring sink failed")
+        finally:
+            self.inflight -= 1
+
+    def _dispatch_chunks(self, dev, val, ts, ingest, ctx, t0,
+                         futs: Optional[list] = None) -> int:
+        """Chunk a flush to the max bucket, dispatch each chunk, and
+        schedule its settle. Sequential dispatch preserves per-device
+        arrival order across chunks. Returns chunks dispatched."""
+        loop = asyncio.get_running_loop()
+        max_b = self.cfg.buckets[-1]
+        n_chunks = 0
+        for lo in range(0, dev.shape[0], max_b):
+            hi = lo + max_b
+            try:
+                scores_dev, uniq, inverse = self._dispatch(dev[lo:hi],
+                                                           val[lo:hi])
+            except Exception:
+                logger.exception("scoring dispatch failed; reloading ring")
+                self.dropped.inc(dev.shape[0] - lo)
+                self._recover_ring()
+                break
+            self.inflight += 1
+            fut = loop.create_future() if futs is not None else None
+            if fut is not None:
+                futs.append(fut)
+            loop.create_task(self._settle_and_deliver(
+                scores_dev, uniq, inverse, dev[lo:hi], ts[lo:hi],
+                ingest[lo:hi], ctx, t0, fut))
+            n_chunks += 1
+        return n_chunks
+
+    def flush_nowait(self) -> bool:
+        """Dispatch the pending admissions; results are delivered to
+        `self.sink` when they settle. Returns False if nothing flushed."""
+        if self._pending_n == 0 or self.inflight >= self.cfg.max_inflight:
+            return False
+        dev, val, ts, ingest, ctx = self._take_pending()
+        return self._dispatch_chunks(dev, val, ts, ingest, ctx,
+                                     time.monotonic()) > 0
+
+    async def flush(self) -> Optional[ScoredBatch]:
+        """Dispatch pending admissions and await the settled batch
+        (tests / callers that want the result inline; the pipeline uses
+        `flush_nowait` + `sink`)."""
+        if self._pending_n == 0:
+            return None
+        dev, val, ts, ingest, ctx = self._take_pending()
+        futs: list[asyncio.Future] = []
+        if self._dispatch_chunks(dev, val, ts, ingest, ctx,
+                                 time.monotonic(), futs) == 0:
+            raise RuntimeError("scoring dispatch failed (ring reloaded)")
+        batches = [await f for f in futs]
+        if len(batches) == 1:
+            return batches[0]
+        return ScoredBatch(
+            ctx, np.concatenate([b.device_index for b in batches]),
+            np.concatenate([b.score for b in batches]),
+            np.concatenate([b.is_anomaly for b in batches]),
+            np.concatenate([b.ts for b in batches]),
+            model_version=self.version)
+
+    def _recover_ring(self) -> None:
+        # the faulted ring's donated buffers are gone — allocate fresh
+        # state FIRST, then repopulate it from the host store
+        self.ring = DeviceRing(self.model.cfg.window,
+                               capacity=self.ring.capacity)
+        try:
+            self._load_ring()
+        except Exception:  # noqa: BLE001 - empty ring still scores (count=0)
+            logger.exception("ring reload from host store failed")
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        """Wait for every dispatched flush to settle (shutdown path)."""
+        deadline = time.monotonic() + timeout
+        while self.inflight > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
 
     def close(self) -> None:
         self._fns.clear()
+        self.ring.close()

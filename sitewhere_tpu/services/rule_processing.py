@@ -105,6 +105,7 @@ class RuleProcessingEngine(TenantEngine):
                                     self.runtime.settings.scoring_batch_window_ms),
             buckets=tuple(cfg.get("buckets",
                                   self.runtime.settings.scoring_batch_buckets)),
+            capacity=cfg.get("capacity", 0),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.shared: bool = cfg.get("shared", False)
@@ -137,7 +138,8 @@ class RuleProcessingEngine(TenantEngine):
         else:
             model = build_model(self.model_name, **self.model_config)
             self.session = ScoringSession(
-                model, em.telemetry, self.runtime.metrics, self.scoring_cfg)
+                model, em.telemetry, self.runtime.metrics, self.scoring_cfg,
+                sink=self._deliver_scored)
 
     async def _do_start(self, monitor) -> None:
         if self.session is not None:
@@ -152,6 +154,7 @@ class RuleProcessingEngine(TenantEngine):
         if task is not None and not task.done():
             task.cancel()
         if self.session is not None:
+            await self.session.drain(timeout=10.0)
             self.session.close()
         if self.pool_slot is not None:
             self.pool_slot.pool.unregister(self.tenant_id)
@@ -207,11 +210,9 @@ class RuleProcessor(BackgroundTaskComponent):
         # flushes itself; slot.flush_due is constant-False)
         sink = engine.session or engine.pool_slot
         session = engine.session
-        scored_topic = engine.tenant_topic(TopicNaming.SCORED_EVENTS)
         api = RuleApi(engine)
-        em = None
         if engine.emit_alerts:
-            em = (await runtime.wait_for_engine("event-management", tenant_id))
+            await runtime.wait_for_engine("event-management", tenant_id)
         # subscribe only after every prior await: a cancellation between
         # subscribe and the try/finally would leak a group member that
         # keeps its partitions assigned and silently starves the group
@@ -234,14 +235,16 @@ class RuleProcessor(BackgroundTaskComponent):
                         except Exception:  # noqa: BLE001 - hook errors isolated
                             logger.exception("hook %s failed", name)
                 if session is not None and session.flush_due:
-                    scored = await session.flush()
-                    if scored is not None:
-                        await runtime.bus.produce(scored_topic, scored,
-                                                  key=scored.ctx.source)
-                        if em is not None and scored.is_anomaly.any():
-                            em.add_alert_batch(
-                                anomaly_alerts(scored, engine.model_name))
-                consumer.commit()
+                    # pipelined: dispatch now; the settled batch reaches
+                    # engine._deliver_scored (publish + alerts) via the
+                    # session sink without blocking this consumer loop
+                    session.flush_nowait()
+                # at-least-once: hold the commit while any consumed event
+                # is still pending, in flight, or awaiting sink delivery —
+                # a crash then redelivers and rescores instead of silently
+                # losing scored output
+                if session is None or session.idle:
+                    consumer.commit()
         finally:
             consumer.close()
 

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from sitewhere_tpu.utils.capacity import grow_pow2
+
+__all__ = ["grow_pow2"]

@@ -176,3 +176,97 @@ def test_python_hook_receives_batches(run):
                 lambda: any(a.type == "custom" for a in em.list_alerts()))
 
     run(main())
+
+
+def test_flush_chunks_fleets_larger_than_max_bucket(run):
+    """A flush with more unique devices than the largest bucket must chunk
+    (sequentially, preserving order), not crash or drop events."""
+
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=300), tenant_id="t")
+        _fill_store(store, sim, 40)
+        delivered = []
+
+        async def sink(batch):
+            delivered.append(batch)
+
+        session = ScoringSession(
+            build_model("zscore", window=32), store, MetricsRegistry(),
+            ScoringConfig(buckets=(128,), batch_window_ms=0.0), sink=sink)
+        session.warmup()
+        batch, _ = sim.tick(t=41 * 60.0)  # 300 devices > bucket 128
+        session.admit(batch)
+        scored = await session.flush()
+        assert len(scored) == 300
+        assert np.isfinite(scored.score).all()
+        await session.drain()
+        assert session.inflight == 0
+        assert sum(len(b) for b in delivered) == 300
+
+    run(main())
+
+
+def test_ring_duplicate_devices_in_one_flush(run):
+    """Several events for one device in a single flush apply in arrival
+    order; every event gets the device's newest-window score."""
+
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=8), tenant_id="t")
+        _fill_store(store, sim, 40)
+        session = ScoringSession(
+            build_model("zscore", window=16), store, MetricsRegistry(),
+            ScoringConfig(buckets=(32,), batch_window_ms=0.0, threshold=4.0))
+        session.warmup()
+        ctx = BatchContext(tenant_id="t", source="test")
+        # device 3 appears 3 times (last value is a huge spike), device 5 once
+        batch = MeasurementBatch(
+            ctx,
+            device_index=np.array([3, 5, 3, 3], np.uint32),
+            mtype=np.zeros(4, np.uint16),
+            value=np.array([20.0, 20.0, 20.0, 500.0], np.float32),
+            ts=np.full(4, 41 * 60.0))
+        session.admit(batch)
+        scored = await session.flush()
+        assert len(scored) == 4
+        by_dev = {(d, i): s for i, (d, s) in
+                  enumerate(zip(scored.device_index, scored.score))}
+        # all three device-3 events share the newest-window score (spiked)
+        d3 = scored.score[scored.device_index == 3]
+        assert (d3 == d3[0]).all() and d3[0] > 4.0
+        assert scored.score[scored.device_index == 5][0] < 4.0
+        # ring state: device 3's newest ring entries include the spike
+        x, valid = session.ring.windows(np.array([3]))
+        assert float(np.asarray(x)[0, -1]) == 500.0
+        # in-order: the two pre-spike values precede it chronologically
+        assert list(np.asarray(x)[0, -3:]) == [20.0, 20.0, 500.0]
+
+    run(main())
+
+
+def test_ring_matches_host_store_windows(run):
+    """The device-resident ring mirrors the host store when events flow
+    through admit/flush (consistency of the two copies)."""
+
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=50), tenant_id="t")
+        _fill_store(store, sim, 20)
+        session = ScoringSession(
+            build_model("zscore", window=16), store, MetricsRegistry(),
+            ScoringConfig(buckets=(64,), batch_window_ms=0.0))
+        session.warmup()  # ring seeded from store
+        for k in range(21, 25):
+            batch, _ = sim.tick(t=60.0 * k)
+            store.append_measurements(batch)
+            session.admit(batch)
+            await session.flush()
+        devices = np.arange(50, dtype=np.uint32)
+        want_x, want_v = store.window(devices, 16)
+        got_x = np.asarray(session.ring.windows(devices)[0])
+        got_v = np.asarray(session.ring.windows(devices)[1])
+        np.testing.assert_allclose(got_x[want_v], want_x[want_v], rtol=1e-6)
+        assert (got_v == want_v).all()
+
+    run(main())
