@@ -309,13 +309,22 @@ class BusConsumer:
             records = self.poll_nowait(max_records)
         return records
 
-    def commit(self) -> None:
-        """Commit current positions to the group (next-offset convention)."""
+    def commit(self, positions: Optional[dict[tuple[str, int], int]] = None) -> None:
+        """Commit positions to the group (next-offset convention).
+
+        With `positions` (a snapshot from `snapshot_positions()`), commits
+        exactly those offsets — the checkpointed-commit pattern: snapshot
+        when the processing pipeline is empty, commit once everything
+        dispatched before the snapshot has been published."""
         state = self._bus._groups[self.group]
-        for tp, pos in self._positions.items():
+        for tp, pos in (positions or self._positions).items():
             prev = state.committed.get(tp, 0)
             if pos > prev:
                 state.committed[tp] = pos
+
+    def snapshot_positions(self) -> dict[tuple[str, int], int]:
+        """Current read positions (for a deferred checkpointed commit)."""
+        return dict(self._positions)
 
     def seek_to_beginning(self) -> None:
         for tp in self._assignment:

@@ -165,17 +165,15 @@ class TenantStack:
         return ((max(n, 1) + d - 1) // d) * d
 
     def score(self, x: np.ndarray, valid: np.ndarray):
-        """Score all tenants at once. x/valid: [T_cap, B, W] → device
-        array [T_cap, B] (caller slices per tenant and np.asarray's)."""
+        """Score all tenants at once from host-materialized windows.
+        x/valid: [T_cap, B, W] → device array [T_cap, B].
+
+        The query/parity path (REST score-now, numerics tests comparing
+        stacked vs per-tenant scoring); the production hot path is
+        `StackedDeviceRing.update_and_score` (scoring/ring.py), which
+        keeps windows device-resident."""
         assert x.shape[0] == self.capacity, (x.shape, self.capacity)
         sh = self._batch_sharding(x.ndim)
         xd = jax.device_put(x, sh)
         vd = jax.device_put(valid, sh)
         return self._fn(x.shape[1])(self.stacked, xd, vd)
-
-    def warm(self, b: int, window: int) -> jax.Array:
-        """Dispatch one dummy scoring call for batch bucket `b` (compile
-        warmer; caller awaits readiness off-loop)."""
-        x = np.zeros((self.capacity, b, window), np.float32)
-        v = np.zeros((self.capacity, b, window), bool)
-        return self.score(x, v)

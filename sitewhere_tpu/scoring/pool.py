@@ -7,12 +7,17 @@ right for a handful of big tenants, wasteful for hundreds of small ones
 operating point [SURVEY.md §7 hard part b]:
 
 - all tenants of one model architecture share a `TenantStack` (stacked
-  params, mesh-sharded over the `model` axis);
+  params, mesh-sharded over the `model` axis) and a `StackedDeviceRing`
+  (stacked per-tenant device histories, resident in TPU HBM with the
+  same tenant-axis sharding);
 - admissions from every tenant land in per-tenant queues; one flusher
   with one admission deadline drains them together;
-- each flush builds a `[T_cap, B, W]` window tensor (per-tenant telemetry
-  gathers on host), runs ONE vmapped scoring call, then fans results back
-  out to each tenant's scored-events topic via its deliver callback.
+- each flush uploads only `[T_cap, B]` (device id, value) deltas, runs
+  ONE vmapped append+gather+score call, and settles the result off-loop
+  (the same pipelined-settle design as the dedicated session: host
+  syncs are round-trip-priced, so they run in threads and never block
+  dispatch), then fans results back out to each tenant's deliver
+  callback.
 
 The pool is keyed by (model name, model config): tenants selecting the
 same architecture share a stack regardless of their thresholds (applied
@@ -33,6 +38,8 @@ from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch, ScoredBat
 from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.parallel.tenant_stack import TenantStack
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.scoring.ring import StackedDeviceRing
+from sitewhere_tpu.scoring.server import _SETTLE_POOL
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +52,7 @@ class PoolConfig:
     batch_window_ms: float = 2.0
     mtype: int = 0
     seed: int = 0
+    max_inflight: int = 64
 
 
 @dataclass
@@ -53,9 +61,10 @@ class _TenantEntry:
     telemetry: TelemetryStore
     threshold: float
     deliver: Deliver
-    pending: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = field(
-        default_factory=list)  # (device_index, ts, ingest_monotonic)
+    pending: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = \
+        field(default_factory=list)  # (device_index, value, ts, ingest)
     pending_n: int = 0
+    inflight: int = 0          # this tenant's share of in-flight flushes
     ctx: Optional[BatchContext] = None
 
 
@@ -83,6 +92,40 @@ class TenantSlot:
         return 0.2
 
     @property
+    def pending_n(self) -> int:
+        entry = self.pool.tenants.get(self.tenant_id)
+        return entry.pending_n if entry is not None else 0
+
+    @property
+    def inflight(self) -> int:
+        entry = self.pool.tenants.get(self.tenant_id)
+        return entry.inflight if entry is not None else 0
+
+    @property
+    def dispatch_count(self) -> int:
+        return self.pool.dispatch_count
+
+    @property
+    def settled_count(self) -> int:
+        return self.pool.settled_count
+
+    @property
+    def settled_through(self) -> int:
+        return self.pool.settled_through
+
+    @property
+    def idle(self) -> bool:
+        """This tenant's commit fast path: nothing of ITS OWN pending or
+        in flight (other tenants' load must not starve this tenant's
+        offset commits or engine stop)."""
+        return self.pending_n == 0 and self.inflight == 0
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.idle and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+
+    @property
     def version(self) -> int:
         return self.pool.stack.versions.get(self.tenant_id, 0)
 
@@ -94,25 +137,40 @@ class TenantSlot:
 
 
 class SharedScoringPool:
-    """One stack + one flusher for every tenant of one model architecture."""
+    """One stack + one ring + one flusher for every tenant of one model
+    architecture."""
 
     def __init__(self, model, metrics: MetricsRegistry,
                  cfg: PoolConfig = PoolConfig(), mesh=None):
         self.model = model
         self.cfg = cfg
+        self.mesh = mesh
         self.stack = TenantStack(model, mesh=mesh, seed=cfg.seed)
+        self.ring: Optional[StackedDeviceRing] = None  # created on first register
         self.tenants: dict[str, _TenantEntry] = {}
         self.ready = True          # flips False while capacity warms up
+        self.inflight = 0
+        self.dispatch_count = 0
+        self.settled_count = 0
+        self._outstanding: set[int] = set()   # dispatched, not yet settled
+        self._pending_max = -1     # highest device index waiting
         self._wake = asyncio.Event()
         self._deadline: Optional[float] = None
         self._flusher: Optional[asyncio.Task] = None
         self._warmup: Optional[asyncio.Task] = None
-        self._warmed_capacity = 0
+        self._warmed_key: tuple = ()
         self.scored_meter = metrics.meter("scoring.events_scored")
         self.latency = metrics.histogram("scoring.e2e_latency_s")
         self.batch_latency = metrics.histogram("scoring.batch_latency_s")
         self.anomalies = metrics.counter("scoring.anomalies_detected")
         self.flush_rounds = metrics.counter("scoring.pool_flush_rounds")
+        self.dropped = metrics.counter("scoring.admissions_dropped")
+        self.sink_failures = metrics.counter("scoring.sink_failures")
+
+    @property
+    def settled_through(self) -> int:
+        """Commit barrier: every dispatch with seq < this has settled."""
+        return min(self._outstanding) if self._outstanding else self.dispatch_count
 
     # -- registration -------------------------------------------------------
 
@@ -121,22 +179,52 @@ class SharedScoringPool:
                  params: Optional[dict] = None) -> TenantSlot:
         if tenant_id in self.tenants:
             raise ValueError(f"tenant {tenant_id!r} already registered")
-        self.stack.add_tenant(tenant_id, params)
+        slot = self.stack.add_tenant(tenant_id, params)
         self.tenants[tenant_id] = _TenantEntry(
             tenant_id, telemetry, threshold, deliver)
+        host = telemetry.channels.get(self.cfg.mtype)
+        host_cap = host.capacity if host is not None else 1024
+        if self.ring is None:
+            self.ring = StackedDeviceRing(
+                self.model.cfg.window, self.stack.capacity,
+                device_cap=host_cap, mesh=self.mesh)
+        else:
+            self.ring.ensure(self.stack.capacity, host_cap - 1)
+            self.ring.clear_tenant(slot)  # a reused slot must not leak history
+        self._seed_tenant_ring(slot, telemetry)
         self._ensure_started()
-        if self.stack.capacity != self._warmed_capacity:
+        if self._current_key() != self._warmed_key:
             self._start_warmup()
         return TenantSlot(self, tenant_id)
 
+    def _seed_tenant_ring(self, slot: int, telemetry: TelemetryStore) -> None:
+        host = telemetry.channels.get(self.cfg.mtype)
+        if host is None:
+            return
+        w = self.model.cfg.window
+        x, _ = host.window(np.arange(host.capacity), w)
+        self.ring.load_tenant(slot, x, np.minimum(host.count, w))
+
     def unregister(self, tenant_id: str) -> None:
-        self.tenants.pop(tenant_id, None)
+        entry = self.tenants.pop(tenant_id, None)
+        slot = self.stack.slots.get(tenant_id)
+        if slot is not None and self.ring is not None:
+            self.ring.clear_tenant(slot)
         self.stack.remove_tenant(tenant_id)
+        if entry is not None and entry.pending_n:
+            self.dropped.inc(entry.pending_n)
 
     def _ensure_started(self) -> None:
         if self._flusher is None or self._flusher.done():
             self._flusher = asyncio.create_task(
                 self._run(), name=f"scoring-pool/{self.model.name}")
+
+    # -- warmup -------------------------------------------------------------
+
+    def _current_key(self) -> tuple:
+        return (self.stack.capacity,
+                self.ring.t_cap if self.ring else 0,
+                self.ring.device_cap if self.ring else 0)
 
     def _start_warmup(self) -> None:
         if self._warmup is not None and not self._warmup.done():
@@ -146,18 +234,21 @@ class SharedScoringPool:
             self._warm_async(), name=f"scoring-pool/{self.model.name}/warmup")
 
     async def _warm_async(self) -> None:
-        """Compile every batch bucket at the current capacity off the hot
-        path; flushes are held (and backlog capped) meanwhile."""
-        cap = self.stack.capacity
-        w = self.model.cfg.window
+        """Compile every batch bucket at the current capacities off the
+        hot path; flushes are held (and backlog capped) meanwhile."""
+        key = self._current_key()
         for b in self.cfg.batch_buckets:
-            out = self.stack.warm(self.stack.pad_batch(b), w)
+            dev = np.full((self.ring.t_cap, b), self.ring.device_cap, np.int32)
+            v = np.zeros((self.ring.t_cap, b), np.float32)
+            out = self.ring.update_and_score(self.model, self.stack.stacked,
+                                             dev, v)
+            self.ring.update(dev, v)
             while not out.is_ready():
                 await asyncio.sleep(0.01)
-            if self.stack.capacity != cap:  # grew again mid-warmup; restart
+            if self._current_key() != key:  # grew mid-warmup; restart
                 self._start_warmup()
                 return
-        self._warmed_capacity = cap
+        self._warmed_key = key
         self.ready = True
         self._wake.set()
 
@@ -166,21 +257,28 @@ class SharedScoringPool:
     def admit(self, tenant_id: str, batch: MeasurementBatch) -> None:
         entry = self.tenants[tenant_id]
         mask = batch.mtype == self.cfg.mtype
-        dev = batch.device_index if mask.all() else batch.device_index[mask]
-        ts = batch.ts if mask.all() else batch.ts[mask]
+        if mask.all():
+            dev, val, ts = batch.device_index, batch.value, batch.ts
+        else:
+            dev, val, ts = (batch.device_index[mask], batch.value[mask],
+                            batch.ts[mask])
         if dev.shape[0] == 0:
             return
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
-        entry.pending.append((dev, ts, ingest))
+        entry.pending.append((dev, val, ts, ingest))
         entry.pending_n += dev.shape[0]
+        if dev.shape[0]:
+            self._pending_max = max(self._pending_max, int(dev.max()))
         entry.ctx = batch.ctx
         if self._deadline is None:
             self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
-        # cap the backlog while compiles run (mirror ScoringSession.admit)
+        # bound the backlog (compiles, regrows, sustained overload):
+        # drop-oldest with a metric beats unbounded growth/OOM
         cap = 16 * self.cfg.batch_buckets[-1]
-        while not self.ready and entry.pending_n > cap and len(entry.pending) > 1:
+        while entry.pending_n > cap and len(entry.pending) > 1:
             old = entry.pending.pop(0)
             entry.pending_n -= old[0].shape[0]
+            self.dropped.inc(old[0].shape[0])
         self._wake.set()
 
     # -- flushing -----------------------------------------------------------
@@ -192,8 +290,8 @@ class SharedScoringPool:
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.batch_buckets:
             if n <= b:
-                return self.stack.pad_batch(b)
-        return self.stack.pad_batch(self.cfg.batch_buckets[-1])
+                return b
+        return self.cfg.batch_buckets[-1]
 
     async def _run(self) -> None:
         while True:
@@ -207,56 +305,136 @@ class SharedScoringPool:
             self._wake.clear()
             if not self.ready or self._total_pending == 0:
                 continue
+            if (self._pending_max >= self.ring.device_cap
+                    or self.stack.capacity != self.ring.t_cap):
+                # a pending event outgrew the ring (or the stack grew):
+                # grow + recompile off the hot path; flushes held
+                self.ring.ensure(self.stack.capacity, self._pending_max)
+                self._start_warmup()
+                continue
+            if self.inflight >= self.cfg.max_inflight:
+                await asyncio.sleep(0.005)
+                self._wake.set()
+                continue
             if (self._deadline is not None
                     and time.monotonic() >= self._deadline) \
                     or self._total_pending >= self.cfg.batch_buckets[-1]:
                 self._deadline = None
-                t0 = time.monotonic()
-                await self.flush_all()
-                self.batch_latency.observe(time.monotonic() - t0)
+                self.flush_rounds.inc()
+                self._flush_round()
 
-    async def flush_all(self) -> None:
-        """Drain every tenant's queue in rounds of one stacked call each."""
-        w = self.model.cfg.window
-        while self._total_pending > 0:
-            # take up to one bucket of rows from every tenant this round
-            takes: dict[str, tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
-            max_n = 0
-            for tid, e in self.tenants.items():
-                if e.pending_n == 0:
-                    continue
-                dev = np.concatenate([p[0] for p in e.pending])
-                ts = np.concatenate([p[1] for p in e.pending])
-                ing = np.concatenate([p[2] for p in e.pending])
-                cut = min(dev.shape[0], self._bucket_for(dev.shape[0]))
-                if cut < dev.shape[0]:
-                    e.pending = [(dev[cut:], ts[cut:], ing[cut:])]
-                    e.pending_n = dev.shape[0] - cut
-                else:
-                    e.pending, e.pending_n = [], 0
-                takes[tid] = (dev[:cut], ts[:cut], ing[:cut])
-                max_n = max(max_n, cut)
-            if not takes:
-                return
-            b = self._bucket_for(max_n)
-            cap = self.stack.capacity
-            x = np.zeros((cap, b, w), np.float32)
-            valid = np.zeros((cap, b, w), bool)
-            for tid, (dev, _, _) in takes.items():
-                slot = self.stack.slots[tid]
-                n = dev.shape[0]
-                x[slot, :n], valid[slot, :n] = \
-                    self.tenants[tid].telemetry.window(dev, w, mtype=self.cfg.mtype)
-            scores_all = np.asarray(self.stack.score(x, valid))
+    def _flush_round(self) -> None:
+        """Take up to one bucket of rows from every tenant, dispatch ONE
+        vmapped call per occurrence round (events for the same device
+        within a take are applied and scored in arrival order, so a
+        coalesced backlog scores identically to per-tick flushes), and
+        schedule the settle. Leftovers re-queue (the wake stays set so
+        the next round follows immediately)."""
+        takes: dict[str, tuple] = {}
+        max_dev = 0
+        for tid, e in self.tenants.items():
+            if e.pending_n == 0:
+                continue
+            dev = np.concatenate([p[0] for p in e.pending])
+            val = np.concatenate([p[1] for p in e.pending])
+            ts = np.concatenate([p[2] for p in e.pending])
+            ing = np.concatenate([p[3] for p in e.pending])
+            cut = min(dev.shape[0], self.cfg.batch_buckets[-1])
+            if cut < dev.shape[0]:
+                e.pending = [(dev[cut:], val[cut:], ts[cut:], ing[cut:])]
+                e.pending_n = dev.shape[0] - cut
+                self._wake.set()
+                if self._deadline is None:
+                    self._deadline = time.monotonic()
+            else:
+                e.pending, e.pending_n = [], 0
+            takes[tid] = (dev[:cut], val[:cut], ts[:cut], ing[:cut])
+            if cut:
+                max_dev = max(max_dev, int(dev[:cut].max()))
+        if self._total_pending == 0:
+            self._pending_max = -1
+        if not takes:
+            return
+        t_cap, d_cap = self.ring.t_cap, self.ring.device_cap
+
+        # split every tenant's take into occurrence rounds
+        metas = []     # (tid, slot, n, dev, ts, ing, [(r, rpos|None, k), ...])
+        round_parts: list[list[tuple[int, np.ndarray, np.ndarray]]] = []
+        for tid, (dev, val, ts, ing) in takes.items():
+            slot = self.stack.slots[tid]
+            n = dev.shape[0]
+            counts = np.unique(dev, return_counts=True)[1] if n else np.array([1])
+            ev_rounds = []
+            if counts.max() == 1:
+                parts = [(dev, val, None)]
+            else:
+                order = np.argsort(dev, kind="stable")
+                sd, sv = dev[order], val[order]
+                _, start, cnts = np.unique(sd, return_index=True,
+                                           return_counts=True)
+                cum = np.arange(n) - np.repeat(start, cnts)
+                parts = [(sd[cum == r], sv[cum == r], order[cum == r])
+                         for r in range(int(cum.max()) + 1)]
+            for r, (rdev, rval, rpos) in enumerate(parts):
+                while len(round_parts) <= r:
+                    round_parts.append([])
+                round_parts[r].append((slot, rdev, rval))
+                ev_rounds.append((r, rpos, rdev.shape[0]))
+            metas.append((tid, slot, n, dev, ts, ing, ev_rounds))
+
+        t0 = time.monotonic()
+        dispatches = []
+        try:
+            for parts in round_parts:
+                b = self._bucket_for(max(p[1].shape[0] for p in parts))
+                dev_in = np.full((t_cap, b), d_cap, np.int32)  # scratch pad
+                val_in = np.zeros((t_cap, b), np.float32)
+                for slot, rdev, rval in parts:
+                    dev_in[slot, :rdev.shape[0]] = rdev
+                    val_in[slot, :rdev.shape[0]] = rval
+                dispatches.append(self.ring.update_and_score(
+                    self.model, self.stack.stacked, dev_in, val_in))
+        except Exception:
+            logger.exception("pool dispatch failed; reseeding ring")
+            self.dropped.inc(sum(m[2] for m in metas))
+            self._recover_ring()
+            return
+        self.inflight += 1
+        seq = self.dispatch_count
+        self.dispatch_count += 1
+        self._outstanding.add(seq)
+        for tid, *_ in metas:
+            e = self.tenants.get(tid)
+            if e is not None:
+                e.inflight += 1
+        asyncio.get_running_loop().create_task(
+            self._settle_and_deliver(dispatches, metas, t0, seq))
+
+    async def _settle_and_deliver(self, dispatches, metas, t0: float,
+                                  seq: Optional[int] = None) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            try:
+                settled = await asyncio.gather(*[
+                    loop.run_in_executor(_SETTLE_POOL, np.asarray, s)
+                    for s in dispatches])
+            except BaseException as exc:
+                if isinstance(exc, Exception):
+                    logger.exception("pool settle failed")
+                    return
+                raise
             now = time.monotonic()
-            self.flush_rounds.inc()
-            for tid, (dev, ts, ing) in takes.items():
+            self.batch_latency.observe(now - t0)
+            for tid, slot, n, dev, ts, ing, ev_rounds in metas:
                 e = self.tenants.get(tid)
                 if e is None:  # unregistered mid-flight
                     continue
-                slot = self.stack.slots[tid]
-                n = dev.shape[0]
-                scores = scores_all[slot, :n].astype(np.float32)
+                scores = np.empty(n, np.float32)
+                for r, rpos, k in ev_rounds:
+                    if rpos is None:
+                        scores[:k] = settled[r][slot, :k]
+                    else:
+                        scores[rpos] = settled[r][slot, :k]
                 is_anom = scores >= e.threshold
                 self.scored_meter.mark(n)
                 self.latency.observe_array(now - ing)
@@ -269,11 +447,39 @@ class SharedScoringPool:
                 try:
                     await e.deliver(scored)
                 except Exception:  # noqa: BLE001 - one tenant can't sink the pool
+                    self.sink_failures.inc()
                     logger.exception("pool deliver failed for tenant %s", tid)
-            await asyncio.sleep(0)
+        finally:
+            self.inflight -= 1
+            self.settled_count += 1
+            if seq is not None:
+                self._outstanding.discard(seq)
+            for tid, *_ in metas:
+                e = self.tenants.get(tid)
+                if e is not None:
+                    e.inflight = max(0, e.inflight - 1)
+
+    def _recover_ring(self) -> None:
+        self.ring = StackedDeviceRing(
+            self.model.cfg.window, self.stack.capacity,
+            device_cap=self.ring.device_cap if self.ring else 1024,
+            mesh=self.mesh)
+        for tid, entry in self.tenants.items():
+            try:
+                self._seed_tenant_ring(self.stack.slots[tid], entry.telemetry)
+            except Exception:  # noqa: BLE001 - empty ring still scores
+                logger.exception("ring reseed failed for tenant %s", tid)
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while ((self.inflight > 0 or self._total_pending > 0)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.01)
 
     def close(self) -> None:
         for task in (self._flusher, self._warmup):
             if task is not None and not task.done():
                 task.cancel()
         self._flusher = self._warmup = None
+        if self.ring is not None:
+            self.ring.close()

@@ -170,3 +170,154 @@ class DeviceRing:
     def close(self) -> None:
         self._update_score_fns.clear()
         self._update_fns.clear()
+
+
+class StackedDeviceRing:
+    """Per-tenant device rings stacked on a leading tenant axis —
+    the pooled (config 4) twin of `DeviceRing`.
+
+    State leaves are `[T_cap, D_cap+1, window]` / `[T_cap, D_cap+1]`;
+    with a mesh, the tenant axis is sharded over `model` (each device
+    holds its tenants' rings resident, mirroring the stacked params in
+    parallel/tenant_stack.py), so one vmapped XLA call appends + scores
+    EVERY tenant with no host-side window materialization and no
+    per-tenant dispatch. Padding writes land in each tenant's scratch
+    row `D_cap`.
+    """
+
+    def __init__(self, window: int, n_tenants: int, device_cap: int = 1024,
+                 mesh=None):
+        self.window = int(window)
+        self.mesh = mesh
+        self.t_cap = int(n_tenants)
+        self.device_cap = grow_pow2(int(device_cap), floor=1024)
+        self._fns: dict[tuple, Callable] = {}
+        self._update_fns: dict[tuple, Callable] = {}
+        self.faulted = False
+        self._alloc()
+
+    def _state_sharding(self, ndim: int):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from sitewhere_tpu.parallel.mesh import MODEL_AXIS
+        return NamedSharding(self.mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
+
+    def _place(self, leaf):
+        sh = self._state_sharding(leaf.ndim)
+        return jax.device_put(leaf, sh) if sh is not None else jax.device_put(leaf)
+
+    def _alloc(self) -> None:
+        t, d, w = self.t_cap, self.device_cap, self.window
+        self.values = self._place(jnp.zeros((t, d + 1, w), jnp.float32))
+        self.count = self._place(jnp.zeros((t, d + 1), jnp.int32))
+        self.cursor = self._place(jnp.zeros((t, d + 1), jnp.int32))
+
+    def ensure(self, n_tenants: int, max_device: int) -> None:
+        """Grow either axis (device-side); recompiles lazily per shape.
+
+        The tenant axis adopts `n_tenants` exactly — it must equal the
+        param stack's capacity (vmap needs matching leading dims); the
+        stack already grows geometrically, so this stays amortized."""
+        new_t = max(self.t_cap, n_tenants)
+        new_d = self.device_cap
+        if max_device >= new_d:
+            new_d = grow_pow2(max_device + 1, floor=new_d * 2)
+        if new_t == self.t_cap and new_d == self.device_cap:
+            return
+        grow_t, grow_d = new_t - self.t_cap, new_d - self.device_cap
+        self.values = self._place(jnp.pad(
+            self.values[:, :-1], ((0, grow_t), (0, grow_d + 1), (0, 0))))
+        self.count = self._place(jnp.pad(
+            self.count[:, :-1], ((0, grow_t), (0, grow_d + 1))))
+        self.cursor = self._place(jnp.pad(
+            self.cursor[:, :-1], ((0, grow_t), (0, grow_d + 1))))
+        self.t_cap, self.device_cap = new_t, new_d
+
+    def load_tenant(self, slot: int, values: np.ndarray,
+                    count: np.ndarray) -> None:
+        """Seed one tenant's rings from host window data (chronological,
+        left-padded — the `TelemetryStore.window` layout)."""
+        n, w = values.shape
+        assert w == self.window
+        self.ensure(slot + 1, n - 1 if n else 0)
+        cnt = np.minimum(count.astype(np.int32), w)
+        idx = (np.arange(w)[None, :] + (w - cnt)[:, None]) % w
+        ring_rows = np.take_along_axis(values.astype(np.float32), idx, axis=1)
+        self.values = self._place(self.values.at[slot, :n].set(ring_rows))
+        self.count = self._place(self.count.at[slot, :n].set(cnt))
+        self.cursor = self._place(self.cursor.at[slot, :n].set(cnt % w))
+        self.faulted = False
+
+    def clear_tenant(self, slot: int) -> None:
+        """Zero a departed tenant's rings (slot reuse must not leak)."""
+        self.values = self._place(self.values.at[slot].set(0.0))
+        self.count = self._place(self.count.at[slot].set(0))
+        self.cursor = self._place(self.cursor.at[slot].set(0))
+
+    def _build_score(self, model) -> Callable:
+        w = self.window
+
+        def tenant_step(params, vals, cnt, cur, dev, v):
+            pos = cur[dev]
+            vals = vals.at[dev, pos].set(v, mode="drop")
+            cur = cur.at[dev].set((pos + 1) % w, mode="drop")
+            cnt = jnp.minimum(cnt.at[dev].add(1, mode="drop"), w)
+            idx = (cur[dev][:, None] - w + jnp.arange(w)[None, :]) % w
+            x = vals[dev[:, None], idx]
+            valid = jnp.arange(w)[None, :] >= (w - cnt[dev])[:, None]
+            return vals, cnt, cur, model.score(params, x, valid)
+
+        return jax.jit(jax.vmap(tenant_step), donate_argnums=(1, 2, 3))
+
+    def _build_update(self) -> Callable:
+        w = self.window
+
+        def tenant_step(vals, cnt, cur, dev, v):
+            pos = cur[dev]
+            vals = vals.at[dev, pos].set(v, mode="drop")
+            cur = cur.at[dev].set((pos + 1) % w, mode="drop")
+            cnt = jnp.minimum(cnt.at[dev].add(1, mode="drop"), w)
+            return vals, cnt, cur
+
+        return jax.jit(jax.vmap(tenant_step), donate_argnums=(0, 1, 2))
+
+    def _pad(self, dev: np.ndarray, v: np.ndarray) -> tuple:
+        """dev/v are already [T_cap, B]; host fills padding with
+        device_cap (the scratch row) before calling."""
+        return (jnp.asarray(dev), jnp.asarray(v))
+
+    def update_and_score(self, model, stacked_params, dev: np.ndarray,
+                         v: np.ndarray) -> jax.Array:
+        """dev: [T_cap, B] int32 (scratch-row-padded), v: [T_cap, B]
+        float32 → [T_cap, B] scores on device (async)."""
+        key = ("s", self.t_cap, self.device_cap, dev.shape[1])
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = self._build_score(model)
+        try:
+            self.values, self.count, self.cursor, scores = fn(
+                stacked_params, self.values, self.count, self.cursor,
+                *self._pad(dev, v))
+        except Exception:
+            self.faulted = True
+            raise
+        return scores
+
+    def update(self, dev: np.ndarray, v: np.ndarray) -> None:
+        key = ("u", self.t_cap, self.device_cap, dev.shape[1])
+        fn = self._update_fns.get(key)
+        if fn is None:
+            fn = self._update_fns[key] = self._build_update()
+        try:
+            self.values, self.count, self.cursor = fn(
+                self.values, self.count, self.cursor,
+                *self._pad(dev, v))
+        except Exception:
+            self.faulted = True
+            raise
+
+    def close(self) -> None:
+        self._fns.clear()
+        self._update_fns.clear()

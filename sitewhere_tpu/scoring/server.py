@@ -89,10 +89,18 @@ class ScoringSession:
         # capped) so no live request pays a compile
         self.ready = True
         self.inflight = 0
+        # monotonic flush progress: dispatch_count - settled_count ==
+        # inflight; the consumer's commit checkpoint compares these to
+        # know when everything admitted before a point has been published
+        self.dispatch_count = 0
+        self.settled_count = 0
+        self._outstanding: set[int] = set()   # dispatched, not yet settled
+        self._regrow_task: Optional[asyncio.Task] = None
         # pending admission state
         self._pending: list[tuple[np.ndarray, np.ndarray, np.ndarray,
                                   np.ndarray, BatchContext]] = []
         self._pending_n = 0
+        self._pending_max = -1      # highest device index waiting
         self._deadline: Optional[float] = None
         # metrics (judge's metrics are first-class [SURVEY.md §5.5])
         self.scored_meter = metrics.meter("scoring.events_scored")
@@ -234,21 +242,35 @@ class ScoringSession:
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
         self._pending.append((dev, val, ts, ingest, batch.ctx))
         self._pending_n += dev.shape[0]
+        if dev.shape[0]:
+            self._pending_max = max(self._pending_max, int(dev.max()))
         if self._deadline is None:
             self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
-        # while warmup compiles, cap the backlog instead of growing forever
+        # bound the backlog (warmup compiles, regrows, sustained overload):
+        # drop-oldest with a metric beats unbounded growth/OOM
         cap = 16 * self.cfg.buckets[-1]
-        while not self.ready and self._pending_n > cap and len(self._pending) > 1:
+        while self._pending_n > cap and len(self._pending) > 1:
             old = self._pending.pop(0)
             self._pending_n -= old[0].shape[0]
             self.dropped.inc(old[0].shape[0])
 
     @property
+    def pending_n(self) -> int:
+        return self._pending_n
+
+    @property
     def idle(self) -> bool:
         """Nothing admitted, dispatched, or awaiting sink delivery — the
-        consumer's commit gate (at-least-once: offsets commit only when
-        every consumed event's scored output has been published)."""
+        consumer's commit fast path (at-least-once: offsets commit only
+        when every consumed event's scored output has been published)."""
         return self._pending_n == 0 and self.inflight == 0
+
+    @property
+    def settled_through(self) -> int:
+        """Every dispatch with seq < this value has settled AND had its
+        sink delivery attempted (settles may complete out of order, so
+        this is the min outstanding seq — the commit barrier)."""
+        return min(self._outstanding) if self._outstanding else self.dispatch_count
 
     @property
     def flush_due(self) -> bool:
@@ -275,6 +297,7 @@ class ScoringSession:
     def _take_pending(self):
         pending, self._pending = self._pending, []
         self._pending_n, self._deadline = 0, None
+        self._pending_max = -1
         dev = np.concatenate([p[0] for p in pending])
         val = np.concatenate([p[1] for p in pending]).astype(np.float32, copy=False)
         ts = np.concatenate([p[2] for p in pending])
@@ -286,52 +309,53 @@ class ScoringSession:
         return dev, val, ts, ingest, ctx
 
     def _dispatch(self, dev, val):
-        """Append + score on device; returns (scores_dev, uniq_dev,
-        inverse) where scores_dev[:len(uniq_dev)] are per-device scores.
+        """Append + score on device; returns a list of round dispatches
+        `(scores_dev, n, positions)` whose scores map back to the
+        original event positions.
 
-        When a flush carries several events for one device, earlier
-        occurrences are applied with append-only steps (in arrival
-        order); the fused scoring step runs on the final occurrences, so
-        every event's score reflects the device's newest window."""
+        When a flush carries several events for one device, occurrences
+        are applied AND scored in arrival order (one fused call per
+        occurrence round), so every event's score reflects the device's
+        window as of that event — a backlog coalesced into one flush
+        scores identically to the same events flushed one tick at a
+        time."""
+        n = dev.shape[0]
         dev = dev.astype(np.int32, copy=False)
         self.ring.ensure_capacity(int(dev.max()))
-        uniq, inverse, counts = np.unique(dev, return_inverse=True,
-                                          return_counts=True)
-        if counts.max() > 1:
+        counts = np.unique(dev, return_counts=True)[1]
+        if counts.max() == 1:
+            rounds = [(dev, val, None)]  # identity mapping
+        else:
             order = np.argsort(dev, kind="stable")
             sd, sv = dev[order], val[order]
             _, start, cnts = np.unique(sd, return_index=True, return_counts=True)
-            cum = np.arange(dev.shape[0]) - np.repeat(start, cnts)
-            last = cum == np.repeat(cnts - 1, cnts)
-            for r in range(int(cum[~last].max()) + 1 if (~last).any() else 0):
-                sel = (cum == r) & ~last
-                if sel.any():
-                    sub_d, sub_v = sd[sel], sv[sel]
-                    self.ring.update(sub_d, sub_v,
-                                     self._bucket_for(sub_d.shape[0]))
-            dev_final, val_final = sd[last], sv[last]
-        else:
-            # no duplicates: score the batch as-is, identity mapping
-            dev_final, val_final = dev, val
-            uniq = dev
-            inverse = np.arange(dev.shape[0])
-        bucket = self._bucket_for(dev_final.shape[0])
-        scores_dev = self.ring.update_and_score(
-            self.model, self.params, dev_final, val_final, bucket)
-        self.batch_size_hist.observe(float(dev_final.shape[0]))
-        return scores_dev, uniq, inverse
+            cum = np.arange(n) - np.repeat(start, cnts)
+            rounds = []
+            for r in range(int(cum.max()) + 1):
+                sel = cum == r
+                rounds.append((sd[sel], sv[sel], order[sel]))
+        dispatches = []
+        for rdev, rval, rpos in rounds:
+            bucket = self._bucket_for(rdev.shape[0])
+            scores_dev = self.ring.update_and_score(
+                self.model, self.params, rdev, rval, bucket)
+            self.batch_size_hist.observe(float(rdev.shape[0]))
+            dispatches.append((scores_dev, rdev.shape[0], rpos))
+        return dispatches
 
-    async def _settle_and_deliver(self, scores_dev, uniq, inverse, dev, ts,
+    async def _settle_and_deliver(self, dispatches, dev, ts,
                                   ingest, ctx, t0: float,
-                                  fut: Optional[asyncio.Future] = None):
+                                  fut: Optional[asyncio.Future] = None,
+                                  seq: Optional[int] = None):
         # inflight covers settle AND sink delivery: drain()/the consumer
         # commit gate must not consider a flush done until its scored
         # output has been published
         loop = asyncio.get_running_loop()
         try:
             try:
-                scores_u = await loop.run_in_executor(_SETTLE_POOL, np.asarray,
-                                                      scores_dev)
+                settled = await asyncio.gather(*[
+                    loop.run_in_executor(_SETTLE_POOL, np.asarray, s)
+                    for s, _, _ in dispatches])
             except BaseException as exc:
                 if fut is not None and not fut.done():
                     fut.set_exception(exc if isinstance(exc, Exception)
@@ -340,7 +364,12 @@ class ScoringSession:
                     logger.exception("scoring settle failed")
                     return
                 raise
-            scores = scores_u[:uniq.shape[0]][inverse].astype(np.float32)
+            scores = np.empty(dev.shape[0], np.float32)
+            for scores_u, (_, n, rpos) in zip(settled, dispatches):
+                if rpos is None:
+                    scores[:n] = scores_u[:n]
+                else:
+                    scores[rpos] = scores_u[:n]
             now = time.monotonic()
             self.scored_meter.mark(dev.shape[0])
             self.latency.observe_array(now - ingest)
@@ -361,6 +390,9 @@ class ScoringSession:
                     logger.exception("scoring sink failed")
         finally:
             self.inflight -= 1
+            self.settled_count += 1
+            if seq is not None:
+                self._outstanding.discard(seq)
 
     def _dispatch_chunks(self, dev, val, ts, ingest, ctx, t0,
                          futs: Optional[list] = None) -> int:
@@ -373,43 +405,72 @@ class ScoringSession:
         for lo in range(0, dev.shape[0], max_b):
             hi = lo + max_b
             try:
-                scores_dev, uniq, inverse = self._dispatch(dev[lo:hi],
-                                                           val[lo:hi])
+                dispatches = self._dispatch(dev[lo:hi], val[lo:hi])
             except Exception:
                 logger.exception("scoring dispatch failed; reloading ring")
                 self.dropped.inc(dev.shape[0] - lo)
                 self._recover_ring()
                 break
             self.inflight += 1
+            seq = self.dispatch_count
+            self.dispatch_count += 1
+            self._outstanding.add(seq)
             fut = loop.create_future() if futs is not None else None
             if fut is not None:
                 futs.append(fut)
             loop.create_task(self._settle_and_deliver(
-                scores_dev, uniq, inverse, dev[lo:hi], ts[lo:hi],
-                ingest[lo:hi], ctx, t0, fut))
+                dispatches, dev[lo:hi], ts[lo:hi],
+                ingest[lo:hi], ctx, t0, fut, seq))
             n_chunks += 1
-        return n_chunks
+        else:
+            return n_chunks, False
+        return n_chunks, True  # broke out: a chunk's dispatch failed
+
+    def _start_regrow(self) -> None:
+        """A pending event's device index outgrew the ring: grow and
+        recompile OFF the hot path (ready=False holds flushes; the
+        admission cap bounds the backlog meanwhile)."""
+        if self._regrow_task is not None and not self._regrow_task.done():
+            return
+        self.ready = False
+
+        async def regrow():
+            while self._pending_max >= self.ring.capacity:
+                self.ring.ensure_capacity(self._pending_max)
+                for out in self._warm_dispatches():
+                    while not out.is_ready():
+                        await asyncio.sleep(0.01)
+            self.ready = True
+
+        self._regrow_task = asyncio.get_running_loop().create_task(
+            regrow(), name="scoring-regrow")
 
     def flush_nowait(self) -> bool:
         """Dispatch the pending admissions; results are delivered to
         `self.sink` when they settle. Returns False if nothing flushed."""
         if self._pending_n == 0 or self.inflight >= self.cfg.max_inflight:
             return False
+        if self._pending_max >= self.ring.capacity:
+            self._start_regrow()  # grow+compile off the hot path
+            return False
         dev, val, ts, ingest, ctx = self._take_pending()
         return self._dispatch_chunks(dev, val, ts, ingest, ctx,
-                                     time.monotonic()) > 0
+                                     time.monotonic())[0] > 0
 
     async def flush(self) -> Optional[ScoredBatch]:
         """Dispatch pending admissions and await the settled batch
         (tests / callers that want the result inline; the pipeline uses
-        `flush_nowait` + `sink`)."""
+        `flush_nowait` + `sink`). Raises if any chunk's dispatch failed
+        (no silent partial results)."""
         if self._pending_n == 0:
             return None
         dev, val, ts, ingest, ctx = self._take_pending()
         futs: list[asyncio.Future] = []
-        if self._dispatch_chunks(dev, val, ts, ingest, ctx,
-                                 time.monotonic(), futs) == 0:
-            raise RuntimeError("scoring dispatch failed (ring reloaded)")
+        _, failed = self._dispatch_chunks(dev, val, ts, ingest, ctx,
+                                          time.monotonic(), futs)
+        if failed:
+            raise RuntimeError("scoring dispatch failed (ring reloaded); "
+                               f"{len(futs)} of the flush's chunks survived")
         batches = [await f for f in futs]
         if len(batches) == 1:
             return batches[0]

@@ -157,6 +157,9 @@ class RuleProcessingEngine(TenantEngine):
             await self.session.drain(timeout=10.0)
             self.session.close()
         if self.pool_slot is not None:
+            # wait for THIS tenant's work only; other tenants' load must
+            # not stall a rolling restart
+            await self.pool_slot.drain(timeout=10.0)
             self.pool_slot.pool.unregister(self.tenant_id)
             self.pool_slot = None
 
@@ -219,6 +222,8 @@ class RuleProcessor(BackgroundTaskComponent):
         consumer = runtime.bus.subscribe(
             engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
             group=f"{tenant_id}.rule-processing")
+        # checkpointed commit state: (dispatch_count at snapshot, positions)
+        ckpt: Optional[tuple[int, dict]] = None
         try:
             while True:
                 timeout = sink.flush_wait_s if sink else 0.2
@@ -239,12 +244,22 @@ class RuleProcessor(BackgroundTaskComponent):
                     # engine._deliver_scored (publish + alerts) via the
                     # session sink without blocking this consumer loop
                     session.flush_nowait()
-                # at-least-once: hold the commit while any consumed event
-                # is still pending, in flight, or awaiting sink delivery —
-                # a crash then redelivers and rescores instead of silently
-                # losing scored output
-                if session is None or session.idle:
+                # at-least-once without commit starvation: when the sink
+                # is idle, commit directly; under steady pipelined load,
+                # snapshot positions whenever nothing sits unflushed and
+                # commit that snapshot once every flush dispatched before
+                # it has settled AND published (settled_through barrier).
+                # A crash redelivers at most the unsettled tail.
+                if sink is None or sink.idle:
                     consumer.commit()
+                    ckpt = None
+                else:
+                    if ckpt is not None and sink.settled_through >= ckpt[0]:
+                        consumer.commit(ckpt[1])
+                        ckpt = None
+                    if ckpt is None and sink.pending_n == 0:
+                        ckpt = (sink.dispatch_count,
+                                consumer.snapshot_positions())
         finally:
             consumer.close()
 
