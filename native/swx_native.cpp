@@ -1,0 +1,108 @@
+// swx native host runtime: the data-plane hot loops that stay on the host.
+//
+// The TPU compute path is JAX/XLA (scoring/ring.py); this library is the
+// native equivalent of the reference's storage/runtime layer (SiteWhere's
+// event datastores behind IDeviceEventManagement, [SURVEY.md §2.2]): the
+// columnar telemetry ring append and window gather that every persisted
+// event passes through. numpy's vectorized append needs a stable sort +
+// unique + cumcount to preserve per-device order (~75 ns/event); the
+// native single pass is a cursor-chasing loop (~5 ns/event) and handles
+// in-batch duplicates by construction.
+//
+// Contract notes:
+// - All arrays are caller-allocated, C-contiguous; this code never
+//   allocates or retains pointers.
+// - Caller guarantees every dev[i] < capacity (the Python wrapper grows
+//   the table first, same as the numpy path).
+// - ctypes releases the GIL for the duration of each call, so appends
+//   from worker threads genuinely parallelize.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libswx.so swx_native.cpp
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Append n events into the [capacity, history] ring (values f32, ts f64),
+// preserving arrival order per device. Returns n.
+int64_t swx_telemetry_append(
+    float* values, double* ts_tab, int64_t* cursor, int64_t* count,
+    int64_t capacity, int64_t history,
+    const uint32_t* dev, const float* vals, const double* ts, int64_t n) {
+    (void)capacity;
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t d = dev[i];
+        const int64_t pos = cursor[d];
+        values[d * history + pos] = vals[i];
+        ts_tab[d * history + pos] = ts[i];
+        const int64_t next = pos + 1;
+        cursor[d] = next == history ? 0 : next;
+        if (count[d] < history) ++count[d];
+    }
+    return n;
+}
+
+// Gather the last `w` values per device, chronological, left-padded.
+// out: [n, w] f32; valid_out: [n, w] bool (uint8).
+void swx_window_gather(
+    const float* values, const int64_t* cursor, const int64_t* count,
+    int64_t history, const uint32_t* dev, int64_t n, int64_t w,
+    float* out, uint8_t* valid_out) {
+    for (int64_t j = 0; j < n; ++j) {
+        const int64_t d = dev[j];
+        const int64_t cur = cursor[d];
+        const int64_t cnt = count[d] < w ? count[d] : w;
+        const int64_t pad = w - cnt;
+        float* orow = out + j * w;
+        uint8_t* vrow = valid_out + j * w;
+        const float* vtab = values + d * history;
+        // start of the chronological window in ring coordinates
+        int64_t pos = cur - w;
+        pos %= history;
+        if (pos < 0) pos += history;
+        // padded slots carry whatever ring data sits there, exactly like
+        // the numpy gather — the valid mask is the contract
+        for (int64_t k = 0; k < w; ++k) {
+            orow[k] = vtab[pos];
+            vrow[k] = k >= pad;
+            ++pos;
+            if (pos == history) pos = 0;
+        }
+    }
+}
+
+// Gather the last `w` timestamps per device (chronological).
+void swx_window_ts_gather(
+    const double* ts_tab, const int64_t* cursor,
+    int64_t history, const uint32_t* dev, int64_t n, int64_t w,
+    double* out) {
+    for (int64_t j = 0; j < n; ++j) {
+        const int64_t d = dev[j];
+        int64_t pos = (cursor[d] - w) % history;
+        if (pos < 0) pos += history;
+        double* orow = out + j * w;
+        const double* ttab = ts_tab + d * history;
+        for (int64_t k = 0; k < w; ++k) {
+            orow[k] = ttab[pos];
+            ++pos;
+            if (pos == history) pos = 0;
+        }
+    }
+}
+
+// Latest (value, ts) per device; ts==0 where never written.
+void swx_latest(
+    const float* values, const double* ts_tab, const int64_t* cursor,
+    int64_t history, const uint32_t* dev, int64_t n,
+    float* val_out, double* ts_out) {
+    for (int64_t j = 0; j < n; ++j) {
+        const int64_t d = dev[j];
+        int64_t pos = cursor[d] - 1;
+        if (pos < 0) pos += history;
+        val_out[j] = values[d * history + pos];
+        ts_out[j] = ts_tab[d * history + pos];
+    }
+}
+
+}  // extern "C"

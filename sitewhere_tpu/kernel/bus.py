@@ -317,7 +317,8 @@ class BusConsumer:
         when the processing pipeline is empty, commit once everything
         dispatched before the snapshot has been published."""
         state = self._bus._groups[self.group]
-        for tp, pos in (positions or self._positions).items():
+        src = positions if positions is not None else self._positions
+        for tp, pos in src.items():
             prev = state.committed.get(tp, 0)
             if pos > prev:
                 state.committed[tp] = pos

@@ -1,0 +1,95 @@
+"""ctypes bridge to the native host runtime (native/swx_native.cpp).
+
+Loads `libswx.so`, building it with g++ on first use (single file, no
+dependencies, ~1s; cached next to the source). Falls back to None — the
+callers keep their numpy paths — when the toolchain or the build is
+unavailable, or when `SWX_NATIVE=0`.
+
+ctypes releases the GIL during calls, so the append path parallelizes
+across service threads — one reason it is native besides the ~15×
+single-thread win over the sort+unique+scatter numpy append.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "swx_native.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libswx.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i64 = ctypes.c_int64
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.warning("native build failed (%s); using numpy paths", exc)
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.swx_telemetry_append.restype = _i64
+    lib.swx_telemetry_append.argtypes = [
+        _f32p, _f64p, _i64p, _i64p, _i64, _i64, _u32p, _f32p, _f64p, _i64]
+    lib.swx_window_gather.restype = None
+    lib.swx_window_gather.argtypes = [
+        _f32p, _i64p, _i64p, _i64, _u32p, _i64, _i64, _f32p, _u8p]
+    lib.swx_window_ts_gather.restype = None
+    lib.swx_window_ts_gather.argtypes = [
+        _f64p, _i64p, _i64, _u32p, _i64, _i64, _f64p]
+    lib.swx_latest.restype = None
+    lib.swx_latest.argtypes = [
+        _f32p, _f64p, _i64p, _i64, _u32p, _i64, _f32p, _f64p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (numpy fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SWX_NATIVE", "1") == "0":
+            return None
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.exists(_SRC)
+                    and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+                if not _build():
+                    return None
+            _lib = _bind(ctypes.CDLL(_SO))
+            logger.info("native host runtime loaded: %s", _SO)
+        except OSError as exc:
+            logger.warning("native load failed (%s); using numpy paths", exc)
+            _lib = None
+    return _lib

@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from sitewhere_tpu.domain.batch import LocationBatch, MeasurementBatch
+from sitewhere_tpu.persistence.native import get_lib
 from sitewhere_tpu.utils import grow_pow2
 
 
@@ -58,11 +59,26 @@ class TelemetryTable:
         self.capacity = new_cap
 
     def append(self, dev: np.ndarray, values: np.ndarray, ts: np.ndarray) -> None:
-        """Vectorized ring append preserving in-batch per-device order."""
+        """Ring append preserving in-batch per-device order.
+
+        Native path (persistence/native.py): one cursor-chasing pass in
+        C++ (handles in-batch duplicates by construction, GIL released).
+        Fallback: vectorized numpy (stable sort + per-device cumcount).
+        """
         n = dev.shape[0]
         if n == 0:
             return
         self._ensure_capacity(int(dev.max()))
+        lib = get_lib()
+        if lib is not None:
+            lib.swx_telemetry_append(
+                self.values, self.ts, self.cursor, self.count,
+                self.capacity, self.history,
+                np.ascontiguousarray(dev, np.uint32),
+                np.ascontiguousarray(values, np.float32),
+                np.ascontiguousarray(ts, np.float64), n)
+            self.total_appended += n
+            return
         dev = dev.astype(np.int64, copy=False)
         order = np.argsort(dev, kind="stable")
         sd = dev[order]
@@ -82,22 +98,48 @@ class TelemetryTable:
         Devices with fewer than `w` points are left-padded; padding slots are
         marked invalid. Output is chronological (oldest → newest).
         """
-        devices = devices.astype(np.int64, copy=False)
         self._ensure_capacity(int(devices.max()) if devices.size else 0)
+        lib = get_lib()
+        if lib is not None and devices.size:
+            n = devices.shape[0]
+            out = np.empty((n, w), np.float32)
+            valid = np.empty((n, w), np.uint8)
+            lib.swx_window_gather(
+                self.values, self.cursor, self.count, self.history,
+                np.ascontiguousarray(devices, np.uint32), n, w, out, valid)
+            return out, valid.view(bool)
+        devices = devices.astype(np.int64, copy=False)
         idx = (self.cursor[devices, None] - w + np.arange(w)[None, :]) % self.history
         out = self.values[devices[:, None], idx]
         valid = np.arange(w)[None, :] >= (w - np.minimum(self.count[devices], w)[:, None])
         return out, valid
 
     def window_ts(self, devices: np.ndarray, w: int) -> np.ndarray:
+        lib = get_lib()
+        if lib is not None and devices.size:
+            n = devices.shape[0]
+            out = np.empty((n, w), np.float64)
+            lib.swx_window_ts_gather(
+                self.ts, self.cursor, self.history,
+                np.ascontiguousarray(devices, np.uint32), n, w, out)
+            return out
         devices = devices.astype(np.int64, copy=False)
         idx = (self.cursor[devices, None] - w + np.arange(w)[None, :]) % self.history
         return self.ts[devices[:, None], idx]
 
     def latest(self, devices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Most recent (value, ts) per device; ts==0 where never written."""
-        devices = devices.astype(np.int64, copy=False)
         self._ensure_capacity(int(devices.max()) if devices.size else 0)
+        lib = get_lib()
+        if lib is not None and devices.size:
+            n = devices.shape[0]
+            val_out = np.empty(n, np.float32)
+            ts_out = np.empty(n, np.float64)
+            lib.swx_latest(self.values, self.ts, self.cursor, self.history,
+                           np.ascontiguousarray(devices, np.uint32), n,
+                           val_out, ts_out)
+            return val_out, ts_out
+        devices = devices.astype(np.int64, copy=False)
         idx = (self.cursor[devices] - 1) % self.history
         return self.values[devices, idx], self.ts[devices, idx]
 

@@ -39,7 +39,7 @@ from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.parallel.tenant_stack import TenantStack
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
 from sitewhere_tpu.scoring.ring import StackedDeviceRing
-from sitewhere_tpu.scoring.server import _SETTLE_POOL
+from sitewhere_tpu.scoring.settle import SETTLE_POOL
 
 logger = logging.getLogger(__name__)
 
@@ -233,21 +233,34 @@ class SharedScoringPool:
         self._warmup = asyncio.create_task(
             self._warm_async(), name=f"scoring-pool/{self.model.name}/warmup")
 
-    async def _warm_async(self) -> None:
+    async def _warm_async(self, attempt: int = 0) -> None:
         """Compile every batch bucket at the current capacities off the
-        hot path; flushes are held (and backlog capped) meanwhile."""
+        hot path; flushes are held (and backlog capped) meanwhile.
+
+        A failure (device fault, OOM at a large bucket) must not stall
+        the pool forever: recover the ring and retry with backoff."""
         key = self._current_key()
-        for b in self.cfg.batch_buckets:
-            dev = np.full((self.ring.t_cap, b), self.ring.device_cap, np.int32)
-            v = np.zeros((self.ring.t_cap, b), np.float32)
-            out = self.ring.update_and_score(self.model, self.stack.stacked,
-                                             dev, v)
-            self.ring.update(dev, v)
-            while not out.is_ready():
-                await asyncio.sleep(0.01)
-            if self._current_key() != key:  # grew mid-warmup; restart
-                self._start_warmup()
-                return
+        try:
+            for b in self.cfg.batch_buckets:
+                dev = np.full((self.ring.t_cap, b), self.ring.device_cap,
+                              np.int32)
+                v = np.zeros((self.ring.t_cap, b), np.float32)
+                out = self.ring.update_and_score(self.model,
+                                                 self.stack.stacked, dev, v)
+                while not out.is_ready():
+                    await asyncio.sleep(0.01)
+                if self._current_key() != key:  # grew mid-warmup; restart
+                    self._start_warmup()
+                    return
+        except Exception:
+            logger.exception("pool warmup failed (attempt %d); recovering "
+                             "ring and retrying", attempt)
+            self._recover_ring(restart_warmup=False)
+            await asyncio.sleep(min(2.0 ** attempt, 30.0))
+            self._warmup = asyncio.create_task(
+                self._warm_async(attempt + 1),
+                name=f"scoring-pool/{self.model.name}/warmup")
+            return
         self._warmed_key = key
         self.ready = True
         self._wake.set()
@@ -416,9 +429,10 @@ class SharedScoringPool:
         try:
             try:
                 settled = await asyncio.gather(*[
-                    loop.run_in_executor(_SETTLE_POOL, np.asarray, s)
+                    loop.run_in_executor(SETTLE_POOL, np.asarray, s)
                     for s in dispatches])
             except BaseException as exc:
+                self.dropped.inc(sum(m[2] for m in metas))
                 if isinstance(exc, Exception):
                     logger.exception("pool settle failed")
                     return
@@ -459,7 +473,7 @@ class SharedScoringPool:
                 if e is not None:
                     e.inflight = max(0, e.inflight - 1)
 
-    def _recover_ring(self) -> None:
+    def _recover_ring(self, restart_warmup: bool = True) -> None:
         self.ring = StackedDeviceRing(
             self.model.cfg.window, self.stack.capacity,
             device_cap=self.ring.device_cap if self.ring else 1024,
@@ -469,6 +483,11 @@ class SharedScoringPool:
                 self._seed_tenant_ring(self.stack.slots[tid], entry.telemetry)
             except Exception:  # noqa: BLE001 - empty ring still scores
                 logger.exception("ring reseed failed for tenant %s", tid)
+        if restart_warmup:
+            # the fresh ring's compile caches are empty: recompile off the
+            # hot path before the next flush (ready gate holds flushes)
+            self._warmed_key = ()
+            self._start_warmup()
 
     async def drain(self, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout

@@ -39,7 +39,6 @@ class DeviceRing:
         self.window = int(window)
         self.capacity = grow_pow2(int(capacity), floor=initial_floor)
         self._update_score_fns: dict[tuple, Callable] = {}
-        self._update_fns: dict[tuple, Callable] = {}
         self.faulted = False  # True after a failed dispatch donated state away
         self._alloc(self.capacity)
 
@@ -102,18 +101,6 @@ class DeviceRing:
 
         return jax.jit(step, donate_argnums=(1, 2, 3))
 
-    def _build_update(self, cap: int, bucket: int) -> Callable:
-        w = self.window
-
-        def step(vals, cnt, cur, dev, v):
-            pos = cur[dev]
-            vals = vals.at[dev, pos].set(v, mode="drop")
-            cur = cur.at[dev].set((pos + 1) % w, mode="drop")
-            cnt = jnp.minimum(cnt.at[dev].add(1, mode="drop"), w)
-            return vals, cnt, cur
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
-
     def _pad(self, dev: np.ndarray, v: np.ndarray,
              bucket: int) -> tuple[np.ndarray, np.ndarray]:
         n = dev.shape[0]
@@ -142,21 +129,6 @@ class DeviceRing:
             raise
         return scores
 
-    def update(self, dev: np.ndarray, v: np.ndarray, bucket: int) -> None:
-        """Append-only step (used for all-but-last occurrences when one
-        flush carries several events for the same device)."""
-        key = (self.capacity, bucket)
-        fn = self._update_fns.get(key)
-        if fn is None:
-            fn = self._update_fns[key] = self._build_update(self.capacity, bucket)
-        pdev, pv = self._pad(dev, v, bucket)
-        try:
-            self.values, self.count, self.cursor = fn(
-                self.values, self.count, self.cursor, pdev, pv)
-        except Exception:
-            self.faulted = True
-            raise
-
     def windows(self, dev: np.ndarray) -> tuple[jax.Array, jax.Array]:
         """Device-resident (x, valid) windows for `dev` — the query path
         (training snapshots use the host store instead)."""
@@ -169,7 +141,6 @@ class DeviceRing:
 
     def close(self) -> None:
         self._update_score_fns.clear()
-        self._update_fns.clear()
 
 
 class StackedDeviceRing:
@@ -192,7 +163,6 @@ class StackedDeviceRing:
         self.t_cap = int(n_tenants)
         self.device_cap = grow_pow2(int(device_cap), floor=1024)
         self._fns: dict[tuple, Callable] = {}
-        self._update_fns: dict[tuple, Callable] = {}
         self.faulted = False
         self._alloc()
 
@@ -271,18 +241,6 @@ class StackedDeviceRing:
 
         return jax.jit(jax.vmap(tenant_step), donate_argnums=(1, 2, 3))
 
-    def _build_update(self) -> Callable:
-        w = self.window
-
-        def tenant_step(vals, cnt, cur, dev, v):
-            pos = cur[dev]
-            vals = vals.at[dev, pos].set(v, mode="drop")
-            cur = cur.at[dev].set((pos + 1) % w, mode="drop")
-            cnt = jnp.minimum(cnt.at[dev].add(1, mode="drop"), w)
-            return vals, cnt, cur
-
-        return jax.jit(jax.vmap(tenant_step), donate_argnums=(0, 1, 2))
-
     def _pad(self, dev: np.ndarray, v: np.ndarray) -> tuple:
         """dev/v are already [T_cap, B]; host fills padding with
         device_cap (the scratch row) before calling."""
@@ -305,19 +263,5 @@ class StackedDeviceRing:
             raise
         return scores
 
-    def update(self, dev: np.ndarray, v: np.ndarray) -> None:
-        key = ("u", self.t_cap, self.device_cap, dev.shape[1])
-        fn = self._update_fns.get(key)
-        if fn is None:
-            fn = self._update_fns[key] = self._build_update()
-        try:
-            self.values, self.count, self.cursor = fn(
-                self.values, self.count, self.cursor,
-                *self._pad(dev, v))
-        except Exception:
-            self.faulted = True
-            raise
-
     def close(self) -> None:
         self._fns.clear()
-        self._update_fns.clear()

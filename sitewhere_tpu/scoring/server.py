@@ -33,7 +33,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
 
@@ -44,14 +43,11 @@ from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch, ScoredBat
 from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
 from sitewhere_tpu.scoring.ring import DeviceRing
+from sitewhere_tpu.scoring.settle import SETTLE_POOL
 
 logger = logging.getLogger(__name__)
 
 Sink = Callable[[ScoredBatch], Awaitable[None]]
-
-# Settle threads are shared across sessions/tenants: each readback holds a
-# worker for one link round trip; readbacks parallelize across threads.
-_SETTLE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="swx-settle")
 
 
 @dataclass(frozen=True)
@@ -125,7 +121,6 @@ class ScoringSession:
         v = np.empty(0, np.float32)
         for b in self.cfg.buckets:
             yield self.ring.update_and_score(self.model, self.params, dev, v, b)
-            self.ring.update(dev, v, b)
             yield self._fn(b)(self.params, jnp.zeros((b, w), jnp.float32),
                               jnp.ones((b, w), jnp.bool_))
 
@@ -141,12 +136,26 @@ class ScoringSession:
     async def warmup_async(self) -> None:
         """Background warmup: compiles block the loop (first TPU compile
         can be tens of seconds over a tunnel), but services are already
-        started and admission is capped meanwhile."""
+        started and admission is capped meanwhile.
+
+        A failure (device fault, OOM) must not hold `ready` False
+        forever: recover the ring and retry with backoff."""
         self.ready = False
-        self._load_ring()
-        for out in self._warm_dispatches():
-            while not out.is_ready():
-                await asyncio.sleep(0.01)
+        attempt = 0
+        while True:
+            try:
+                self._load_ring()
+                for out in self._warm_dispatches():
+                    while not out.is_ready():
+                        await asyncio.sleep(0.01)
+                break
+            except Exception:
+                logger.exception("scoring warmup failed (attempt %d); "
+                                 "recovering ring and retrying", attempt)
+                self.ring = DeviceRing(self.model.cfg.window,
+                                       capacity=self.ring.capacity)
+                await asyncio.sleep(min(2.0 ** attempt, 30.0))
+                attempt += 1
         self.ready = True
 
     def _load_ring(self) -> None:
@@ -214,7 +223,7 @@ class ScoringSession:
             scores_dev = self._fn(bucket)(self.params, x, valid)
             self.batch_size_hist.observe(float(n))
             settles.append((loop.run_in_executor(
-                _SETTLE_POOL, np.asarray, scores_dev), n))
+                SETTLE_POOL, np.asarray, scores_dev), n))
         outs = [(await fut)[:n] for fut, n in settles]
         scores = np.concatenate(outs) if len(outs) > 1 else outs[0]
         now = time.monotonic()
@@ -267,9 +276,10 @@ class ScoringSession:
 
     @property
     def settled_through(self) -> int:
-        """Every dispatch with seq < this value has settled AND had its
-        sink delivery attempted (settles may complete out of order, so
-        this is the min outstanding seq — the commit barrier)."""
+        """Every dispatch with seq < this value has either settled (sink
+        delivery attempted) or been accounted as dropped — settles may
+        complete out of order, so this is the min outstanding seq (the
+        commit barrier)."""
         return min(self._outstanding) if self._outstanding else self.dispatch_count
 
     @property
@@ -354,12 +364,16 @@ class ScoringSession:
         try:
             try:
                 settled = await asyncio.gather(*[
-                    loop.run_in_executor(_SETTLE_POOL, np.asarray, s)
+                    loop.run_in_executor(SETTLE_POOL, np.asarray, s)
                     for s, _, _ in dispatches])
             except BaseException as exc:
                 if fut is not None and not fut.done():
                     fut.set_exception(exc if isinstance(exc, Exception)
                                       else RuntimeError("settle cancelled"))
+                # these events' scores are lost; account them so the
+                # commit barrier advancing is an explicit drop, not a
+                # silent one
+                self.dropped.inc(dev.shape[0])
                 if isinstance(exc, Exception):
                     logger.exception("scoring settle failed")
                     return
@@ -435,11 +449,19 @@ class ScoringSession:
         self.ready = False
 
         async def regrow():
+            attempt = 0
             while self._pending_max >= self.ring.capacity:
-                self.ring.ensure_capacity(self._pending_max)
-                for out in self._warm_dispatches():
-                    while not out.is_ready():
-                        await asyncio.sleep(0.01)
+                try:
+                    self.ring.ensure_capacity(self._pending_max)
+                    for out in self._warm_dispatches():
+                        while not out.is_ready():
+                            await asyncio.sleep(0.01)
+                except Exception:
+                    logger.exception("ring regrow failed (attempt %d); "
+                                     "recovering and retrying", attempt)
+                    self._recover_ring()
+                    await asyncio.sleep(min(2.0 ** attempt, 30.0))
+                    attempt += 1
             self.ready = True
 
         self._regrow_task = asyncio.get_running_loop().create_task(

@@ -1,0 +1,8 @@
+"""Shared settle executor: device→host readbacks are round-trip-priced
+(~66 ms over a tunneled chip, size-independent) but parallelize across
+threads and release the GIL — so every session and pool settles results
+on this one pool of workers instead of blocking the event loop."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+SETTLE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="swx-settle")

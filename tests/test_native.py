@@ -1,0 +1,111 @@
+"""Native host runtime (native/swx_native.cpp via persistence/native.py):
+exact parity with the numpy fallback paths, duplicate handling, and the
+GIL-released speed claim (smoke-level)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.persistence.native import get_lib
+from sitewhere_tpu.persistence.telemetry import TelemetryTable
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="native library unavailable (no g++?)")
+
+
+def _numpy_table(history, devices):
+    """A table forced onto the numpy path for parity comparison."""
+    t = TelemetryTable(history=history, initial_devices=devices)
+    return t
+
+
+def _run_append(table, dev, vals, ts, native: bool):
+    if native:
+        table.append(dev, vals, ts)
+        return
+    # numpy reference path, copied semantics (sort + cumcount)
+    n = dev.shape[0]
+    d = dev.astype(np.int64)
+    order = np.argsort(d, kind="stable")
+    sd = d[order]
+    uniq, start, counts = np.unique(sd, return_index=True, return_counts=True)
+    cum = np.arange(n, dtype=np.int64) - np.repeat(start, counts)
+    pos = (table.cursor[sd] + cum) % table.history
+    table.values[sd, pos] = vals[order]
+    table.ts[sd, pos] = ts[order]
+    table.cursor[uniq] = (table.cursor[uniq] + counts) % table.history
+    table.count[uniq] = np.minimum(table.count[uniq] + counts, table.history)
+
+
+def test_native_append_and_window_match_numpy():
+    rng = np.random.default_rng(0)
+    hist, ndev, w = 32, 64, 16
+    nat = TelemetryTable(history=hist, initial_devices=ndev)
+    ref = TelemetryTable(history=hist, initial_devices=ndev)
+    for _ in range(7):
+        n = int(rng.integers(1, 200))
+        dev = rng.integers(0, ndev, n).astype(np.uint32)  # duplicates likely
+        vals = rng.normal(size=n).astype(np.float32)
+        ts = rng.uniform(1, 2, n)
+        _run_append(nat, dev, vals, ts, native=True)
+        _run_append(ref, dev, vals, ts, native=False)
+        np.testing.assert_array_equal(nat.cursor, ref.cursor)
+        np.testing.assert_array_equal(nat.count, ref.count)
+        np.testing.assert_array_equal(nat.values, ref.values)
+        np.testing.assert_array_equal(nat.ts, ref.ts)
+    devices = np.arange(ndev, dtype=np.uint32)
+    # window: native gather vs the numpy expression
+    x_nat, v_nat = nat.window(devices, w)
+    idx = (ref.cursor[devices, None] - w + np.arange(w)[None, :]) % hist
+    x_ref = ref.values[devices[:, None], idx]
+    v_ref = (np.arange(w)[None, :]
+             >= (w - np.minimum(ref.count[devices], w)[:, None]))
+    np.testing.assert_array_equal(x_nat, x_ref)
+    np.testing.assert_array_equal(v_nat, v_ref)
+    # window_ts + latest parity
+    ts_nat = nat.window_ts(devices, w)
+    ts_ref = ref.ts[devices[:, None], idx]
+    np.testing.assert_array_equal(ts_nat, ts_ref)
+    lv, lt = nat.latest(devices)
+    li = (ref.cursor[devices.astype(np.int64)] - 1) % hist
+    np.testing.assert_array_equal(lv, ref.values[devices, li])
+    np.testing.assert_array_equal(lt, ref.ts[devices, li])
+
+
+def test_native_append_in_batch_duplicate_order():
+    t = TelemetryTable(history=8, initial_devices=4)
+    dev = np.array([1, 1, 1, 2, 1], np.uint32)
+    vals = np.arange(5, dtype=np.float32)
+    t.append(dev, vals, np.ones(5))
+    x, valid = t.window(np.array([1, 2], np.uint32), 4)
+    assert list(x[0]) == [0.0, 1.0, 2.0, 4.0]  # device 1, arrival order
+    assert valid[0].tolist() == [True] * 4
+    assert x[1][-1] == 3.0 and valid[1].tolist() == [False, False, False, True]
+
+
+def test_native_ring_wraparound():
+    t = TelemetryTable(history=4, initial_devices=2)
+    for k in range(10):
+        t.append(np.array([0], np.uint32),
+                 np.array([float(k)], np.float32), np.array([float(k)]))
+    x, valid = t.window(np.array([0], np.uint32), 4)
+    assert list(x[0]) == [6.0, 7.0, 8.0, 9.0]
+    assert valid[0].all()
+
+
+def test_native_speed_smoke():
+    """Not a benchmark — just proof the native path isn't pathologically
+    slow (it should beat numpy's sort+scatter comfortably)."""
+    n, ndev = 16384, 16384
+    t = TelemetryTable(history=256, initial_devices=ndev)
+    dev = np.arange(n, dtype=np.uint32)
+    vals = np.zeros(n, np.float32)
+    ts = np.zeros(n)
+    t.append(dev, vals, ts)  # warm
+    t0 = time.perf_counter()
+    for _ in range(10):
+        t.append(dev, vals, ts)
+    per_event = (time.perf_counter() - t0) / 10 / n
+    assert per_event < 100e-9 * 50, f"native append too slow: {per_event*1e9:.0f} ns/event"
