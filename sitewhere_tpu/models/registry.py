@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from sitewhere_tpu.models.longwin import LongWindowConfig, LongWindowModel
 from sitewhere_tpu.models.lstm import LstmAnomalyModel, LstmConfig
 from sitewhere_tpu.models.tft import TftConfig, TftForecaster
 from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
@@ -17,6 +18,7 @@ MODEL_REGISTRY: dict[str, tuple[type, type]] = {
     "lstm": (LstmConfig, LstmAnomalyModel),
     "tft": (TftConfig, TftForecaster),
     "zscore": (ZScoreConfig, ZScoreModel),
+    "longwin": (LongWindowConfig, LongWindowModel),
 }
 
 

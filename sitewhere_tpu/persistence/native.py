@@ -43,14 +43,23 @@ _i64 = ctypes.c_int64
 def _build() -> bool:
     if not os.path.exists(_SRC):
         return False
+    # temp file + os.replace: concurrent builders (multiple services,
+    # pytest workers) never load a half-written .so. No -march=native:
+    # the cached artifact may be loaded on a different host (shared
+    # checkout), and an ISA mismatch is an uncatchable SIGILL.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-             "-o", _SO, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError) as exc:
         logger.warning("native build failed (%s); using numpy paths", exc)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -30,6 +30,15 @@ from sitewhere_tpu.persistence.native import get_lib
 from sitewhere_tpu.utils import grow_pow2
 
 
+def _check_indices(dev: np.ndarray) -> None:
+    """Device indices are dense non-negative slots; a negative index would
+    wrap to ~4e9 under the native paths' uint32 cast (out-of-bounds C++
+    write) and silently alias a ring row under numpy — both are caller
+    bugs, so fail loudly."""
+    if dev.size and int(dev.min()) < 0:
+        raise ValueError(f"negative device index: {int(dev.min())}")
+
+
 class TelemetryTable:
     """Ring buffer of one scalar channel for up to `capacity` devices."""
 
@@ -68,6 +77,7 @@ class TelemetryTable:
         n = dev.shape[0]
         if n == 0:
             return
+        _check_indices(dev)
         self._ensure_capacity(int(dev.max()))
         lib = get_lib()
         if lib is not None:
@@ -98,6 +108,7 @@ class TelemetryTable:
         Devices with fewer than `w` points are left-padded; padding slots are
         marked invalid. Output is chronological (oldest → newest).
         """
+        _check_indices(devices)
         self._ensure_capacity(int(devices.max()) if devices.size else 0)
         lib = get_lib()
         if lib is not None and devices.size:
@@ -115,6 +126,8 @@ class TelemetryTable:
         return out, valid
 
     def window_ts(self, devices: np.ndarray, w: int) -> np.ndarray:
+        _check_indices(devices)
+        self._ensure_capacity(int(devices.max()) if devices.size else 0)
         lib = get_lib()
         if lib is not None and devices.size:
             n = devices.shape[0]
@@ -129,6 +142,7 @@ class TelemetryTable:
 
     def latest(self, devices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Most recent (value, ts) per device; ts==0 where never written."""
+        _check_indices(devices)
         self._ensure_capacity(int(devices.max()) if devices.size else 0)
         lib = get_lib()
         if lib is not None and devices.size:

@@ -40,6 +40,7 @@ from sitewhere_tpu.parallel.tenant_stack import TenantStack
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
 from sitewhere_tpu.scoring.ring import StackedDeviceRing
 from sitewhere_tpu.scoring.settle import SETTLE_POOL
+from sitewhere_tpu.utils.retry import retry_backoff
 
 logger = logging.getLogger(__name__)
 
@@ -233,35 +234,36 @@ class SharedScoringPool:
         self._warmup = asyncio.create_task(
             self._warm_async(), name=f"scoring-pool/{self.model.name}/warmup")
 
-    async def _warm_async(self, attempt: int = 0) -> None:
+    async def _warm_async(self) -> None:
         """Compile every batch bucket at the current capacities off the
         hot path; flushes are held (and backlog capped) meanwhile.
 
         A failure (device fault, OOM at a large bucket) must not stall
-        the pool forever: recover the ring and retry with backoff."""
-        key = self._current_key()
-        try:
-            for b in self.cfg.batch_buckets:
-                dev = np.full((self.ring.t_cap, b), self.ring.device_cap,
-                              np.int32)
-                v = np.zeros((self.ring.t_cap, b), np.float32)
-                out = self.ring.update_and_score(self.model,
-                                                 self.stack.stacked, dev, v)
-                while not out.is_ready():
-                    await asyncio.sleep(0.01)
-                if self._current_key() != key:  # grew mid-warmup; restart
-                    self._start_warmup()
+        the pool forever: recover the ring and retry with backoff (the
+        retry helper keeps recovery inside the protected scope). If the
+        capacities grow mid-warmup, the attempt restarts at the new
+        shapes until a full pass completes at a stable key."""
+
+        async def attempt():
+            while True:
+                key = self._current_key()
+                for b in self.cfg.batch_buckets:
+                    dev = np.full((self.ring.t_cap, b), self.ring.device_cap,
+                                  np.int32)
+                    v = np.zeros((self.ring.t_cap, b), np.float32)
+                    out = self.ring.update_and_score(
+                        self.model, self.stack.stacked, dev, v)
+                    while not out.is_ready():
+                        await asyncio.sleep(0.01)
+                    if self._current_key() != key:
+                        break  # grew mid-warmup; recompile at new shapes
+                else:
+                    self._warmed_key = key
                     return
-        except Exception:
-            logger.exception("pool warmup failed (attempt %d); recovering "
-                             "ring and retrying", attempt)
-            self._recover_ring(restart_warmup=False)
-            await asyncio.sleep(min(2.0 ** attempt, 30.0))
-            self._warmup = asyncio.create_task(
-                self._warm_async(attempt + 1),
-                name=f"scoring-pool/{self.model.name}/warmup")
-            return
-        self._warmed_key = key
+
+        await retry_backoff(
+            attempt, lambda: self._recover_ring(restart_warmup=False),
+            logger, "pool warmup")
         self.ready = True
         self._wake.set()
 

@@ -44,6 +44,7 @@ from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
 from sitewhere_tpu.scoring.ring import DeviceRing
 from sitewhere_tpu.scoring.settle import SETTLE_POOL
+from sitewhere_tpu.utils.retry import retry_backoff
 
 logger = logging.getLogger(__name__)
 
@@ -112,8 +113,8 @@ class ScoringSession:
 
     def _warm_dispatches(self):
         """Yield one (bucket-compile) device result per call round: the
-        fused hot path, the append-only step (duplicate rounds), and the
-        host-window query path all get their buckets precompiled."""
+        fused update+score hot path and the host-window query path both
+        get their buckets precompiled."""
         import jax.numpy as jnp
 
         w = self.model.cfg.window
@@ -139,23 +140,22 @@ class ScoringSession:
         started and admission is capped meanwhile.
 
         A failure (device fault, OOM) must not hold `ready` False
-        forever: recover the ring and retry with backoff."""
+        forever: recover the ring and retry with backoff (the retry
+        helper keeps recovery inside the protected scope, so even a
+        failing recovery cannot kill the task)."""
         self.ready = False
-        attempt = 0
-        while True:
-            try:
-                self._load_ring()
-                for out in self._warm_dispatches():
-                    while not out.is_ready():
-                        await asyncio.sleep(0.01)
-                break
-            except Exception:
-                logger.exception("scoring warmup failed (attempt %d); "
-                                 "recovering ring and retrying", attempt)
-                self.ring = DeviceRing(self.model.cfg.window,
-                                       capacity=self.ring.capacity)
-                await asyncio.sleep(min(2.0 ** attempt, 30.0))
-                attempt += 1
+
+        async def attempt():
+            self._load_ring()
+            for out in self._warm_dispatches():
+                while not out.is_ready():
+                    await asyncio.sleep(0.01)
+
+        def recover():
+            self.ring = DeviceRing(self.model.cfg.window,
+                                   capacity=self.ring.capacity)
+
+        await retry_backoff(attempt, recover, logger, "scoring warmup")
         self.ready = True
 
     def _load_ring(self) -> None:
@@ -449,19 +449,15 @@ class ScoringSession:
         self.ready = False
 
         async def regrow():
-            attempt = 0
-            while self._pending_max >= self.ring.capacity:
-                try:
+            async def attempt():
+                while self._pending_max >= self.ring.capacity:
                     self.ring.ensure_capacity(self._pending_max)
                     for out in self._warm_dispatches():
                         while not out.is_ready():
                             await asyncio.sleep(0.01)
-                except Exception:
-                    logger.exception("ring regrow failed (attempt %d); "
-                                     "recovering and retrying", attempt)
-                    self._recover_ring()
-                    await asyncio.sleep(min(2.0 ** attempt, 30.0))
-                    attempt += 1
+
+            await retry_backoff(attempt, self._recover_ring, logger,
+                                "ring regrow")
             self.ready = True
 
         self._regrow_task = asyncio.get_running_loop().create_task(

@@ -1,0 +1,102 @@
+"""Long-window sequence-parallel forecaster (models/longwin.py):
+dense vs ring-attention SP parity, gradient flow under shard_map, and a
+short training run [SURVEY.md §5.7]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from sitewhere_tpu.models.longwin import LongWindowConfig, LongWindowModel
+from sitewhere_tpu.models.registry import build_model
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _data(rng, B, W):
+    t = np.arange(W)
+    base = 10 + 3 * np.sin(2 * np.pi * t / 32)
+    x = base[None] + rng.normal(0, 0.3, (B, W))
+    valid = np.ones((B, W), bool)
+    valid[:, : rng.integers(0, 8)] = False  # some left padding
+    return jnp.asarray(x, jnp.float32), jnp.asarray(valid)
+
+
+def test_sequence_parallel_matches_dense():
+    cfg = LongWindowConfig(window=128, hidden=16, heads=2, layers=2,
+                           compute_dtype=jnp.float32)
+    dense = LongWindowModel(cfg)
+    sp = LongWindowModel(cfg, mesh=_mesh())
+    params = dense.init(jax.random.PRNGKey(0))
+    x, valid = _data(np.random.default_rng(0), 4, cfg.window)
+    s_dense = np.asarray(dense.score(params, x, valid))
+    s_sp = np.asarray(sp.score(params, x, valid))
+    np.testing.assert_allclose(s_sp, s_dense, rtol=1e-4, atol=1e-4)
+    l_dense = float(dense.loss(params, x, valid))
+    l_sp = float(sp.loss(params, x, valid))
+    np.testing.assert_allclose(l_sp, l_dense, rtol=1e-4)
+
+
+def test_sequence_parallel_gradients_match_dense():
+    cfg = LongWindowConfig(window=64, hidden=8, heads=2, layers=1,
+                           compute_dtype=jnp.float32)
+    dense = LongWindowModel(cfg)
+    sp = LongWindowModel(cfg, mesh=_mesh())
+    params = dense.init(jax.random.PRNGKey(1))
+    x, valid = _data(np.random.default_rng(1), 2, cfg.window)
+    g_dense = jax.grad(lambda p: dense.loss(p, x, valid))(params)
+    g_sp = jax.grad(lambda p: sp.loss(p, x, valid))(params)
+    flat_d, _ = jax.flatten_util.ravel_pytree(g_dense)
+    flat_s, _ = jax.flatten_util.ravel_pytree(g_sp)
+    np.testing.assert_allclose(np.asarray(flat_s), np.asarray(flat_d),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_longwin_short_training_reduces_loss():
+    model = build_model("longwin", window=64, hidden=16, heads=2, layers=1,
+                        compute_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(2))
+    x, valid = _data(np.random.default_rng(2), 16, 64)
+    opt = optax.adam(3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(model.loss)(params, x, valid)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    first = None
+    for k in range(60):
+        params, state, loss = step(params, state)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < 0.7 * first, (first, float(loss))
+
+
+def test_longwin_scores_quantile_violations_after_fit():
+    """A fitted model scores an injected spike far above clean devices."""
+    cfg = LongWindowConfig(window=64, hidden=16, heads=2, layers=1,
+                           compute_dtype=jnp.float32, min_history=16)
+    model = LongWindowModel(cfg)
+    params = model.init(jax.random.PRNGKey(3))
+    rng = np.random.default_rng(3)
+    x, valid = _data(rng, 32, 64)
+    opt = optax.adam(3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(model.loss)(params, x, valid)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    for _ in range(150):
+        params, state, _ = step(params, state)
+    x_test, valid_test = _data(rng, 8, 64)
+    x_spiked = x_test.at[:4, -1].add(25.0)
+    scores = np.asarray(jax.jit(model.score)(params, x_spiked, valid_test))
+    assert scores[:4].min() > 3 * max(scores[4:].max(), 1e-3), scores
