@@ -110,6 +110,8 @@ async def run_bench(args) -> dict:
     lat_hist.reset()
 
     # ---- phase 1: saturation throughput (open loop + drain) ----
+    if args.profile:  # jax.profiler trace of the measured window
+        jax.profiler.start_trace(args.profile)
     t0 = time.monotonic()
     k = 0
     sent = 0
@@ -124,6 +126,8 @@ async def run_bench(args) -> dict:
            and time.monotonic() < deadline):
         await asyncio.sleep(0.05)
     elapsed = time.monotonic() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
     scored = lat_hist.count
     rate = scored / elapsed if elapsed > 0 else 0.0
 
@@ -180,6 +184,8 @@ def main() -> None:
     parser.add_argument("--history", type=int, default=256)
     parser.add_argument("--latency-seconds", type=float, default=5.0)
     parser.add_argument("--paced-fraction", type=float, default=0.7)
+    parser.add_argument("--profile", default=None, metavar="DIR",
+                        help="write a jax.profiler trace of phase 1 to DIR")
     args = parser.parse_args()
     result = asyncio.run(run_bench(args))
     print(json.dumps(result))

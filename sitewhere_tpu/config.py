@@ -35,6 +35,7 @@ class InstanceSettings:
     jwt_secret: str = "swx-dev-secret"
     jwt_expiration_s: int = 3600
     # scoring plane
+    trace_sample: int = 64     # record spans for every Nth trace [SURVEY §5.1]
     scoring_batch_window_ms: float = 2.0
     scoring_batch_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
     # log level

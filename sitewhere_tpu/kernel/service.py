@@ -191,6 +191,8 @@ class ServiceRuntime(LifecycleComponent):
         self.settings = settings
         self.naming = TopicNaming(settings.instance_id)
         self.metrics = MetricsRegistry()
+        from sitewhere_tpu.kernel.tracing import Tracer
+        self.tracer = Tracer(sample=settings.trace_sample)
         self.bus = EventBus(default_partitions=settings.bus_default_partitions,
                             retention=settings.bus_retention)
         self.add_child(self.bus)

@@ -1,0 +1,91 @@
+"""Per-event pipeline tracing [SURVEY.md §5.1].
+
+The reference has no distributed tracing in core (logging only); the
+rebuild carries a trace context in every batch envelope
+(`BatchContext.trace_id`, stamped at the receiver) and records one SPAN
+per pipeline stage into a bounded in-memory ring:
+
+    receiver → decode → enrich → persist → score → deliver
+
+Sampling keeps the hot path honest: at 1M events/s nobody can afford a
+span per batch per stage, so only every `sample`-th trace id records
+(trace ids are dense counters, so modulo sampling is uniform). The
+model plane's profiler story is `jax.profiler` (bench.py --profile).
+
+`Tracer.spans()` / `Tracer.trace(trace_id)` are the query surface (REST
+exposes them); `record()` is the single write path (kept lean: the hot
+pipeline calls it per batch per stage).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True, slots=True)
+class Span:
+    trace_id: int
+    stage: str            # e.g. "event-sources.decode"
+    tenant_id: str
+    t_start: float        # monotonic
+    duration_s: float
+    n_events: int
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "stage": self.stage,
+                "tenant": self.tenant_id, "t_start": self.t_start,
+                "duration_ms": round(self.duration_s * 1e3, 3),
+                "n_events": self.n_events}
+
+
+class Tracer:
+    """Bounded span ring with modulo sampling. One per runtime."""
+
+    def __init__(self, capacity: int = 4096, sample: int = 64):
+        self.sample = max(int(sample), 1)
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+
+    def new_trace_id(self) -> int:
+        """Dense trace ids (stamped at the receiver)."""
+        return next(self._ids)
+
+    def sampled(self, trace_id: int) -> bool:
+        return trace_id > 0 and trace_id % self.sample == 0
+
+    def record(self, trace_id: int, stage: str, tenant_id: str,
+               t_start: float, duration_s: float, n_events: int = 0) -> None:
+        if self.sampled(trace_id):
+            self._spans.append(Span(trace_id, stage, tenant_id, t_start,
+                                    duration_s, n_events))
+
+    # -- query surface -----------------------------------------------------
+
+    def spans(self, stage: Optional[str] = None,
+              limit: int = 256) -> list[Span]:
+        out = [s for s in reversed(self._spans)
+               if stage is None or s.stage == stage]
+        return out[:limit]
+
+    def trace(self, trace_id: int) -> list[Span]:
+        """Every recorded span of one trace, in time order — the
+        pipeline's journey for one ingest batch."""
+        return sorted((s for s in self._spans if s.trace_id == trace_id),
+                      key=lambda s: s.t_start)
+
+    def stage_summary(self) -> dict[str, dict]:
+        """Mean/max duration + event counts per stage (ops dashboard)."""
+        agg: dict[str, list[Span]] = {}
+        for s in self._spans:
+            agg.setdefault(s.stage, []).append(s)
+        return {
+            stage: {
+                "count": len(ss),
+                "mean_ms": round(sum(x.duration_s for x in ss) / len(ss) * 1e3, 3),
+                "max_ms": round(max(x.duration_s for x in ss) * 1e3, 3),
+                "events": sum(x.n_events for x in ss),
+            } for stage, ss in agg.items()
+        }

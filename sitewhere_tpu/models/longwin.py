@@ -64,6 +64,7 @@ class LongWindowModel:
                  mesh: Optional[Mesh] = None):
         self.cfg = cfg
         self.mesh = mesh
+        self._axis_size = None if mesh is None else mesh.shape[cfg.seq_axis]
         if mesh is not None:
             assert cfg.window % mesh.shape[cfg.seq_axis] == 0, \
                 "window must divide across the sequence axis"
@@ -128,7 +129,8 @@ class LongWindowModel:
             if axis_name is None:
                 attn = dense_attention_reference(q, k, v, valid, causal=True)
             else:
-                attn = ring_attention(q, k, v, valid, axis_name, causal=True)
+                attn = ring_attention(q, k, v, valid, axis_name, causal=True,
+                                      axis_size=self._axis_size)
             attn = attn.reshape(B, T, d)
             hx = hx + (attn.astype(cdt) @ p["o"]["w"].astype(cdt)
                        ).astype(jnp.float32) + p["o"]["b"]

@@ -51,15 +51,19 @@ def _block_attend(q, k, v, kv_valid, scale, causal, q_pos, k_pos):
 
 
 def ring_attention(q, k, v, valid, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   axis_size: Optional[int] = None):
     """Blockwise ring attention inside a shard_map over `axis_name`.
 
     Every device holds its local blocks; K/V (+validity) rotate P-1 hops
     around the ring while the online softmax folds each visiting block
-    into the local queries' accumulator. Returns [B, T_local, H, Dh]
-    (f32) — same layout as the inputs.
+    into the local queries' accumulator (the final fold does NOT rotate
+    — the blocks are back where attention needs them, and a P-th
+    rotation would be a wasted ICI round trip). Returns
+    [B, T_local, H, Dh] (f32) — same layout as the inputs.
     """
-    P_sz = jax.lax.psum(1, axis_name)
+    P_sz = int(axis_size) if axis_size is not None \
+        else jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, T_l, H, Dh = q.shape
     scale = scale if scale is not None else Dh ** -0.5
@@ -78,8 +82,7 @@ def ring_attention(q, k, v, valid, axis_name: str, causal: bool = False,
 
     perm = [(i, (i + 1) % P_sz) for i in range(P_sz)]
 
-    def fold(state, step):
-        o, m, l, k_cur, v_cur, valid_cur = state
+    def accumulate(o, m, l, k_cur, v_cur, valid_cur, step):
         owner = (idx - step) % P_sz          # whose block is visiting
         scores = _block_attend(q, k_cur, v_cur, valid_cur, scale, causal,
                                q_pos, k_positions(owner))
@@ -92,14 +95,20 @@ def ring_attention(q, k, v, valid, axis_name: str, causal: bool = False,
         l = l * corr + p.sum(-1)
         pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
         o = o * corr.transpose(0, 2, 1)[..., None] + pv
-        # rotate K/V/validity to the next device (skip after last fold)
+        return o, new_m, l
+
+    def fold(state, step):
+        o, m, l, k_cur, v_cur, valid_cur = state
+        o, m, l = accumulate(o, m, l, k_cur, v_cur, valid_cur, step)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         valid_nxt = jax.lax.ppermute(valid_cur, axis_name, perm)
-        return (o, new_m, l, k_nxt, v_nxt, valid_nxt), None
+        return (o, m, l, k_nxt, v_nxt, valid_nxt), None
 
-    (o, m, l, *_), _ = jax.lax.scan(
-        fold, (o, m, l, k, v, valid), jnp.arange(P_sz))
+    if P_sz > 1:  # P-1 rotating folds, then one final fold with no rotate
+        (o, m, l, k, v, valid), _ = jax.lax.scan(
+            fold, (o, m, l, k, v, valid), jnp.arange(P_sz - 1))
+    o, m, l = accumulate(o, m, l, k, v, valid, P_sz - 1)
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return o / denom
 
@@ -112,8 +121,11 @@ def ring_attention_sharded(q, k, v, valid, mesh: Mesh, seq_axis: str,
     spec_qkv = P(None, seq_axis, None, None)
     spec_valid = P(None, seq_axis)
 
+    axis_size = mesh.shape[seq_axis]
+
     def body(q, k, v, valid):
-        return ring_attention(q, k, v, valid, seq_axis, causal=causal)
+        return ring_attention(q, k, v, valid, seq_axis, causal=causal,
+                              axis_size=axis_size)
 
     fn = jax.shard_map(
         body, mesh=mesh,

@@ -265,6 +265,10 @@ class RestServer(LifecycleComponent):
         r("GET", r"/api/instance/health", self.get_health, authority=None)
         r("GET", r"/api/instance/metrics", self.get_metrics)
         r("GET", r"/api/instance/topics", self.get_topics)
+        # pipeline tracing [SURVEY.md §5.1]
+        r("GET", r"/api/instance/traces", self.get_trace_summary)
+        r("GET", r"/api/instance/traces/spans", self.get_trace_spans)
+        r("GET", r"/api/instance/traces/(?P<id>\d+)", self.get_trace)
         # users / tenants
         r("GET", r"/api/users", self.list_users, AUTH_ADMIN_USERS)
         r("POST", r"/api/users", self.create_user, AUTH_ADMIN_USERS)
@@ -356,6 +360,19 @@ class RestServer(LifecycleComponent):
 
     async def get_metrics(self, req: Request):
         return self.runtime.metrics.snapshot()
+
+    async def get_trace_summary(self, req: Request):
+        return self.runtime.tracer.stage_summary()
+
+    async def get_trace_spans(self, req: Request):
+        spans = self.runtime.tracer.spans(
+            stage=req.qp("stage"), limit=req.int_qp("limit", 256))
+        return {"spans": [s.to_dict() for s in spans]}
+
+    async def get_trace(self, req: Request):
+        spans = self.runtime.tracer.trace(int(req.params["id"]))
+        return {"trace_id": int(req.params["id"]),
+                "spans": [s.to_dict() for s in spans]}
 
     async def get_topics(self, req: Request):
         bus = self.runtime.bus

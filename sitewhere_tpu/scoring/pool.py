@@ -62,8 +62,9 @@ class _TenantEntry:
     telemetry: TelemetryStore
     threshold: float
     deliver: Deliver
-    pending: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = \
-        field(default_factory=list)  # (device_index, value, ts, ingest)
+    pending: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                        BatchContext]] = \
+        field(default_factory=list)  # (device_index, value, ts, ingest, ctx)
     pending_n: int = 0
     inflight: int = 0          # this tenant's share of in-flight flushes
     ctx: Optional[BatchContext] = None
@@ -142,10 +143,11 @@ class SharedScoringPool:
     architecture."""
 
     def __init__(self, model, metrics: MetricsRegistry,
-                 cfg: PoolConfig = PoolConfig(), mesh=None):
+                 cfg: PoolConfig = PoolConfig(), mesh=None, tracer=None):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
+        self.tracer = tracer
         self.stack = TenantStack(model, mesh=mesh, seed=cfg.seed)
         self.ring: Optional[StackedDeviceRing] = None  # created on first register
         self.tenants: dict[str, _TenantEntry] = {}
@@ -280,7 +282,7 @@ class SharedScoringPool:
         if dev.shape[0] == 0:
             return
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
-        entry.pending.append((dev, val, ts, ingest))
+        entry.pending.append((dev, val, ts, ingest, batch.ctx))
         entry.pending_n += dev.shape[0]
         if dev.shape[0]:
             self._pending_max = max(self._pending_max, int(dev.max()))
@@ -355,15 +357,28 @@ class SharedScoringPool:
             ts = np.concatenate([p[2] for p in e.pending])
             ing = np.concatenate([p[3] for p in e.pending])
             cut = min(dev.shape[0], self.cfg.batch_buckets[-1])
+            # score spans attribute to each admitted batch's trace; on a
+            # partial take, split at the cut (the tail re-queues and gets
+            # its span next round)
+            traces = []
+            remaining = cut
+            for p in e.pending:
+                k = min(p[0].shape[0], remaining)
+                if k > 0:
+                    traces.append((p[4].trace_id, k))
+                    remaining -= k
+                if remaining == 0:
+                    break
             if cut < dev.shape[0]:
-                e.pending = [(dev[cut:], val[cut:], ts[cut:], ing[cut:])]
+                e.pending = [(dev[cut:], val[cut:], ts[cut:], ing[cut:],
+                              e.pending[-1][4])]
                 e.pending_n = dev.shape[0] - cut
                 self._wake.set()
                 if self._deadline is None:
                     self._deadline = time.monotonic()
             else:
                 e.pending, e.pending_n = [], 0
-            takes[tid] = (dev[:cut], val[:cut], ts[:cut], ing[:cut])
+            takes[tid] = (dev[:cut], val[:cut], ts[:cut], ing[:cut], traces)
             if cut:
                 max_dev = max(max_dev, int(dev[:cut].max()))
         if self._total_pending == 0:
@@ -373,9 +388,9 @@ class SharedScoringPool:
         t_cap, d_cap = self.ring.t_cap, self.ring.device_cap
 
         # split every tenant's take into occurrence rounds
-        metas = []     # (tid, slot, n, dev, ts, ing, [(r, rpos|None, k), ...])
+        metas = []  # (tid, slot, n, dev, ts, ing, traces, ev_rounds)
         round_parts: list[list[tuple[int, np.ndarray, np.ndarray]]] = []
-        for tid, (dev, val, ts, ing) in takes.items():
+        for tid, (dev, val, ts, ing, traces) in takes.items():
             slot = self.stack.slots[tid]
             n = dev.shape[0]
             counts = np.unique(dev, return_counts=True)[1] if n else np.array([1])
@@ -395,7 +410,7 @@ class SharedScoringPool:
                     round_parts.append([])
                 round_parts[r].append((slot, rdev, rval))
                 ev_rounds.append((r, rpos, rdev.shape[0]))
-            metas.append((tid, slot, n, dev, ts, ing, ev_rounds))
+            metas.append((tid, slot, n, dev, ts, ing, traces, ev_rounds))
 
         t0 = time.monotonic()
         dispatches = []
@@ -441,7 +456,7 @@ class SharedScoringPool:
                 raise
             now = time.monotonic()
             self.batch_latency.observe(now - t0)
-            for tid, slot, n, dev, ts, ing, ev_rounds in metas:
+            for tid, slot, n, dev, ts, ing, traces, ev_rounds in metas:
                 e = self.tenants.get(tid)
                 if e is None:  # unregistered mid-flight
                     continue
@@ -460,6 +475,10 @@ class SharedScoringPool:
                 ctx = e.ctx or BatchContext(tenant_id=tid, source="pool")
                 scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
                                      model_version=self.stack.versions[tid])
+                if self.tracer is not None:
+                    for trace_id, n_ev in traces:
+                        self.tracer.record(trace_id, "rule-processing.score",
+                                           tid, t0, now - t0, n_ev)
                 try:
                     await e.deliver(scored)
                 except Exception:  # noqa: BLE001 - one tenant can't sink the pool

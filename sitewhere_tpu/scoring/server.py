@@ -68,11 +68,13 @@ class ScoringSession:
 
     def __init__(self, model, telemetry: TelemetryStore,
                  metrics: MetricsRegistry, cfg: ScoringConfig = ScoringConfig(),
-                 params: Optional[dict] = None, sink: Optional[Sink] = None):
+                 params: Optional[dict] = None, sink: Optional[Sink] = None,
+                 tracer=None):
         self.model = model
         self.telemetry = telemetry
         self.cfg = cfg
         self.sink = sink
+        self.tracer = tracer
         self.params = jax.device_put(
             params if params is not None
             else model.init(jax.random.PRNGKey(cfg.seed)))
@@ -316,7 +318,10 @@ class ScoringSession:
         ctx = pending[0][4] if len(sources) == 1 else BatchContext(
             tenant_id=pending[0][4].tenant_id, source="+".join(sorted(sources)),
             ingest_monotonic=min(p[4].ingest_monotonic for p in pending))
-        return dev, val, ts, ingest, ctx
+        # every admitted batch's trace gets its own score span (a flush
+        # coalesces many traces; attributing all to one hides the rest)
+        traces = [(p[4].trace_id, p[0].shape[0]) for p in pending]
+        return dev, val, ts, ingest, ctx, traces
 
     def _dispatch(self, dev, val):
         """Append + score on device; returns a list of round dispatches
@@ -356,7 +361,8 @@ class ScoringSession:
     async def _settle_and_deliver(self, dispatches, dev, ts,
                                   ingest, ctx, t0: float,
                                   fut: Optional[asyncio.Future] = None,
-                                  seq: Optional[int] = None):
+                                  seq: Optional[int] = None,
+                                  traces: Optional[list] = None):
         # inflight covers settle AND sink delivery: drain()/the consumer
         # commit gate must not consider a flush done until its scored
         # output has been published
@@ -394,6 +400,11 @@ class ScoringSession:
                 self.anomalies.inc(n_anom)
             scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
                                  model_version=self.version)
+            if self.tracer is not None:
+                for trace_id, n_ev in (traces or [(ctx.trace_id,
+                                                   dev.shape[0])]):
+                    self.tracer.record(trace_id, "rule-processing.score",
+                                       ctx.tenant_id, t0, now - t0, n_ev)
             if fut is not None and not fut.done():
                 fut.set_result(scored)
             if self.sink is not None:
@@ -409,7 +420,8 @@ class ScoringSession:
                 self._outstanding.discard(seq)
 
     def _dispatch_chunks(self, dev, val, ts, ingest, ctx, t0,
-                         futs: Optional[list] = None) -> int:
+                         futs: Optional[list] = None,
+                         traces: Optional[list] = None) -> tuple:
         """Chunk a flush to the max bucket, dispatch each chunk, and
         schedule its settle. Sequential dispatch preserves per-device
         arrival order across chunks. Returns chunks dispatched."""
@@ -434,7 +446,8 @@ class ScoringSession:
                 futs.append(fut)
             loop.create_task(self._settle_and_deliver(
                 dispatches, dev[lo:hi], ts[lo:hi],
-                ingest[lo:hi], ctx, t0, fut, seq))
+                ingest[lo:hi], ctx, t0, fut, seq,
+                traces if lo == 0 else None))
             n_chunks += 1
         else:
             return n_chunks, False
@@ -471,9 +484,10 @@ class ScoringSession:
         if self._pending_max >= self.ring.capacity:
             self._start_regrow()  # grow+compile off the hot path
             return False
-        dev, val, ts, ingest, ctx = self._take_pending()
+        dev, val, ts, ingest, ctx, traces = self._take_pending()
         return self._dispatch_chunks(dev, val, ts, ingest, ctx,
-                                     time.monotonic())[0] > 0
+                                     time.monotonic(),
+                                     traces=traces)[0] > 0
 
     async def flush(self) -> Optional[ScoredBatch]:
         """Dispatch pending admissions and await the settled batch
@@ -482,10 +496,11 @@ class ScoringSession:
         (no silent partial results)."""
         if self._pending_n == 0:
             return None
-        dev, val, ts, ingest, ctx = self._take_pending()
+        dev, val, ts, ingest, ctx, traces = self._take_pending()
         futs: list[asyncio.Future] = []
         _, failed = self._dispatch_chunks(dev, val, ts, ingest, ctx,
-                                          time.monotonic(), futs)
+                                          time.monotonic(), futs,
+                                          traces=traces)
         if failed:
             raise RuntimeError("scoring dispatch failed (ring reloaded); "
                                f"{len(futs)} of the flush's chunks survived")

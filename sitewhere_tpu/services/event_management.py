@@ -12,6 +12,7 @@ hop; that cost is deleted by design).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Sequence
 
 from sitewhere_tpu.config import TenantConfig
@@ -87,6 +88,7 @@ class EventPersister(BackgroundTaskComponent):
             while True:
                 for record in await consumer.poll(max_records=256, timeout=0.2):
                     batch = record.value
+                    t_span = time.monotonic()
                     if isinstance(batch, MeasurementBatch):
                         persisted.mark(spi.add_measurements(batch))
                     elif isinstance(batch, LocationBatch):
@@ -113,6 +115,12 @@ class EventPersister(BackgroundTaskComponent):
                         continue
                     await runtime.bus.produce(enriched_topic, batch,
                                               key=record.key)
+                    ctx = getattr(batch, "ctx", None)
+                    if ctx is not None:
+                        runtime.tracer.record(
+                            ctx.trace_id, "event-management.persist",
+                            tenant_id, t_span, time.monotonic() - t_span,
+                            len(batch))
                 consumer.commit()
         finally:
             consumer.close()

@@ -261,9 +261,12 @@ class EventSourcesEngine(TenantEngine):
     async def process_payload(self, payload: bytes, source: str,
                               decoder: EventDecoder,
                               ingest_monotonic: Optional[float] = None) -> None:
-        ctx = BatchContext(tenant_id=self.tenant_id, source=source)
+        tracer = self.runtime.tracer
+        ctx = BatchContext(tenant_id=self.tenant_id, source=source,
+                           trace_id=tracer.new_trace_id())
         if ingest_monotonic is not None:
             ctx.ingest_monotonic = ingest_monotonic
+        t0 = time.monotonic()
         try:
             batches = decoder.decode(payload, ctx)
         except Exception as exc:  # noqa: BLE001 - failed decode is data, not a crash
@@ -272,6 +275,9 @@ class EventSourcesEngine(TenantEngine):
                 self._failed_topic, {"payload": payload, "error": repr(exc),
                                      "source": source})
             return
+        tracer.record(ctx.trace_id, "event-sources.decode", self.tenant_id,
+                      t0, time.monotonic() - t0,
+                      sum(len(b) for b in batches))
         for batch in batches:
             n = len(batch)
             if n:

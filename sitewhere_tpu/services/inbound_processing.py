@@ -14,6 +14,7 @@ the same at-least-once semantics.
 from __future__ import annotations
 
 import logging
+import time
 
 import numpy as np
 
@@ -63,6 +64,7 @@ class InboundProcessor(BackgroundTaskComponent):
                 dm = dm_service.engines.get(tenant_id, dm)
                 for record in await consumer.poll(max_records=256, timeout=0.2):
                     batch = record.value
+                    t_span = time.monotonic()
                     if isinstance(batch, (MeasurementBatch, LocationBatch)):
                         mask = dm.registered_mask(batch.device_index)
                         n_bad = int((~mask).sum())
@@ -77,6 +79,9 @@ class InboundProcessor(BackgroundTaskComponent):
                             processed.mark(len(batch))
                             await runtime.bus.produce(inbound_topic, batch,
                                                       key=record.key)
+                        runtime.tracer.record(
+                            batch.ctx.trace_id, "inbound.enrich", tenant_id,
+                            t_span, time.monotonic() - t_span, len(batch))
                     elif isinstance(batch, RegistrationBatch):
                         await runtime.bus.produce(unregistered_topic, batch)
                     else:

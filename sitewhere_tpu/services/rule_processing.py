@@ -139,7 +139,7 @@ class RuleProcessingEngine(TenantEngine):
             model = build_model(self.model_name, **self.model_config)
             self.session = ScoringSession(
                 model, em.telemetry, self.runtime.metrics, self.scoring_cfg,
-                sink=self._deliver_scored)
+                sink=self._deliver_scored, tracer=self.runtime.tracer)
 
     async def _do_start(self, monitor) -> None:
         if self.session is not None:
@@ -300,7 +300,7 @@ class RuleProcessingService(Service):
                 PoolConfig(batch_buckets=scoring_cfg.buckets,
                            batch_window_ms=scoring_cfg.batch_window_ms,
                            mtype=scoring_cfg.mtype, seed=scoring_cfg.seed),
-                mesh=mesh)
+                mesh=mesh, tracer=self.runtime.tracer)
             self._pools[key] = pool
         return pool
 

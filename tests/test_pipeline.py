@@ -173,3 +173,37 @@ def test_simulator_anomaly_injection():
     deviation = np.abs(batch.value - own_base)
     assert deviation[truth].min() > 5.0
     assert deviation[~truth].max() < 5.0
+
+
+def test_pipeline_spans_recorded(run):
+    """§5.1: sampled traces leave one span per pipeline stage, queryable
+    by trace id (decode → enrich → persist → score)."""
+
+    async def main():
+        from tests.test_pipeline import running_pipeline, wait_until
+        sections = {"rule-processing": {"model": "zscore",
+                                        "model_config": {"window": 16},
+                                        "batch_window_ms": 1.0}}
+        async with running_pipeline(num_devices=20, sections=sections) as rt:
+            rt.tracer.sample = 1  # record every trace for the test
+            from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+            sim = DeviceSimulator(SimConfig(num_devices=20), tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme").receiver("default")
+            session = rt.api("rule-processing").engine("acme").session
+            for k in range(20):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            await wait_until(lambda: session.latency.count >= 400)
+            summary = rt.tracer.stage_summary()
+            for stage in ("event-sources.decode", "inbound.enrich",
+                          "event-management.persist", "rule-processing.score"):
+                assert stage in summary, (stage, summary.keys())
+                assert summary[stage]["events"] > 0
+            # one trace's journey is ordered decode → ... → score
+            scored = [s for s in rt.tracer.spans("rule-processing.score")
+                      if s.n_events > 0]
+            journey = rt.tracer.trace(scored[0].trace_id)
+            stages = [s.stage for s in journey]
+            assert stages.index("event-sources.decode") == 0
+            assert "event-management.persist" in stages
+
+    run(main())

@@ -293,3 +293,48 @@ def test_rest_batch_and_training(run):
             assert len(elements) == 3
 
     run(main())
+
+
+def test_rest_trace_endpoints(run):
+    """Pipeline spans are queryable over REST [SURVEY.md §5.1]."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            rt.tracer.sample = 1
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            status, _ = await http(
+                port, "POST", "/api/tenants", token=tok,
+                body={"token": "acme", "name": "Acme",
+                      "sections": {"rule-processing": {"model": None}}})
+            assert status == 200
+            # push a few payloads through the pipeline
+            from sitewhere_tpu.domain.model import DeviceType
+            from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+            rt.api("device-management").management("acme").bootstrap_fleet(
+                DeviceType(token="thermo", name="T"), 10)
+            sim = DeviceSimulator(SimConfig(num_devices=10), tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme").receiver("default")
+            for k in range(5):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            em = rt.api("event-management").management("acme")
+            from tests.test_pipeline import wait_until
+            await wait_until(lambda: em.telemetry.total_events == 50)
+
+            status, summary = await http(port, "GET", "/api/instance/traces",
+                                         token=tok)
+            assert status == 200
+            assert "event-sources.decode" in summary
+            status, spans = await http(
+                port, "GET", "/api/instance/traces/spans?stage=inbound.enrich",
+                token=tok)
+            assert status == 200 and spans["spans"]
+            tid = spans["spans"][0]["trace_id"]
+            status, journey = await http(
+                port, "GET", f"/api/instance/traces/{tid}", token=tok)
+            assert status == 200
+            assert [s["stage"] for s in journey["spans"]][0] == \
+                "event-sources.decode"
+
+    run(main())
