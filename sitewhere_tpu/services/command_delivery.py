@@ -107,6 +107,35 @@ class TcpPushDeliveryProvider:
             return False
 
 
+class MqttDeliveryProvider:
+    """Deliver commands to devices subscribed over the MQTT ingest
+    endpoint (reference: MqttCommandDeliveryProvider publishing to
+    per-device command topics). The device subscribes to
+    `swx/commands/<device-token>` on the same connection it publishes
+    telemetry on; delivery is a QoS0 PUBLISH down that session."""
+
+    def __init__(self, runtime, tenant_id: str,
+                 receiver_name: str = "mqtt",
+                 topic_prefix: str = "swx/commands/"):
+        self.runtime = runtime
+        self.tenant_id = tenant_id
+        self.receiver_name = receiver_name
+        self.topic_prefix = topic_prefix
+
+    async def deliver(self, device: Device, payload: bytes) -> bool:
+        try:
+            engine = self.runtime.api("event-sources").engine(self.tenant_id)
+            receiver = engine.receiver(self.receiver_name)
+        except KeyError:
+            return False
+        listener = getattr(receiver, "listener", None)
+        if listener is None:
+            return False
+        n = await listener.publish_to_subscribers(
+            f"{self.topic_prefix}{device.token}", payload)
+        return n > 0
+
+
 class CommandDeliveryEngine(TenantEngine):
     def __init__(self, service: "CommandDeliveryService", tenant: TenantConfig):
         super().__init__(service, tenant)
@@ -114,7 +143,11 @@ class CommandDeliveryEngine(TenantEngine):
         self.encoders: dict[str, CommandEncoder] = {
             "json": JsonCommandEncoder(), "swb1": Swb1CommandEncoder()}
         self.providers: dict[str, DeliveryProvider] = {
-            "queue": QueueDeliveryProvider(), "tcp": TcpPushDeliveryProvider()}
+            "queue": QueueDeliveryProvider(), "tcp": TcpPushDeliveryProvider(),
+            "mqtt": MqttDeliveryProvider(
+                self.runtime, self.tenant_id,
+                receiver_name=cfg.get("mqtt_receiver", "mqtt"),
+                topic_prefix=cfg.get("mqtt_topic_prefix", "swx/commands/"))}
         self.default_encoder = cfg.get("encoder", "json")
         self.default_provider = cfg.get("provider", "queue")
         self.routes: dict[str, dict] = cfg.get("routes", {})

@@ -172,6 +172,7 @@ class TcpEventReceiver(BackgroundTaskComponent):
         self.host, self.port = host, port
         self.max_frame = max_frame or self.MAX_FRAME
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set[asyncio.StreamWriter] = set()
 
     async def _do_start(self, monitor) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -179,6 +180,7 @@ class TcpEventReceiver(BackgroundTaskComponent):
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
         try:
             while True:
                 header = await reader.readexactly(4)
@@ -193,6 +195,7 @@ class TcpEventReceiver(BackgroundTaskComponent):
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     async def _run(self) -> None:  # server runs itself; nothing to poll
@@ -204,6 +207,43 @@ class TcpEventReceiver(BackgroundTaskComponent):
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+
+
+class MqttEventReceiver(BackgroundTaskComponent):
+    """MQTT ingest endpoint (reference analog: MqttInboundEventReceiver).
+    Hosts a minimal MQTT 3.1.1 server (services/mqtt.py) — any standard
+    device client can CONNECT and PUBLISH SWB1/JSON payloads at QoS 0/1.
+    The MQTT topic becomes the batch source."""
+
+    def __init__(self, name: str, engine: "EventSourcesEngine",
+                 decoder: EventDecoder, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(name)
+        self.engine = engine
+        self.decoder = decoder
+        from sitewhere_tpu.services.mqtt import MqttListener
+
+        self.listener = MqttListener(self._on_publish, host=host, port=port)
+
+    @property
+    def port(self) -> int:
+        return self.listener.port
+
+    async def _on_publish(self, topic: str, payload: bytes,
+                          client_id: str) -> None:
+        await self.engine.process_payload(
+            payload, f"{self.name}:{topic}", self.decoder,
+            ingest_monotonic=time.monotonic())
+
+    async def _do_start(self, monitor) -> None:
+        await self.listener.start()
+
+    async def _run(self) -> None:  # server runs itself
+        await asyncio.Event().wait()
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        await self.listener.stop()
 
 
 class EventSourcesEngine(TenantEngine):
@@ -246,6 +286,10 @@ class EventSourcesEngine(TenantEngine):
             r = TcpEventReceiver(name, self, decoder,
                                  host=cfg.get("host", "127.0.0.1"),
                                  port=cfg.get("port", 0))
+        elif kind == "mqtt":
+            r = MqttEventReceiver(name, self, decoder,
+                                  host=cfg.get("host", "127.0.0.1"),
+                                  port=cfg.get("port", 0))
         else:
             raise ValueError(f"unknown receiver kind {kind!r}")
         self.receivers.append(r)

@@ -1,0 +1,234 @@
+"""Dependency-free MQTT 3.1.1 ingest listener.
+
+The reference's primary device protocol is MQTT (`MqttInboundEventReceiver`
+connecting out to a broker, [SURVEY.md §2.2 event-sources]). This image has
+no MQTT client library and no broker, so the TPU-native rebuild hosts the
+endpoint itself: a minimal asyncio server speaking the broker side of MQTT
+3.1.1 — enough for any standard device client to CONNECT and PUBLISH
+telemetry at QoS 0/1:
+
+  CONNECT→CONNACK, PUBLISH(QoS0) , PUBLISH(QoS1)→PUBACK,
+  SUBSCRIBE→SUBACK (accepted; no outbound fan-out yet),
+  PINGREQ→PINGRESP, DISCONNECT.
+
+Published payloads are handed to the receiver's decoder exactly like TCP
+frames; the topic is carried as the batch source so per-topic routing
+rules keep working. Command delivery down to subscribed devices rides the
+same connection registry (command-delivery's MQTT provider).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# MQTT 3.1.1 control packet types (spec §2.2.1)
+CONNECT, CONNACK = 1, 2
+PUBLISH, PUBACK = 3, 4
+SUBSCRIBE, SUBACK = 8, 9
+UNSUBSCRIBE, UNSUBACK = 10, 11
+PINGREQ, PINGRESP = 12, 13
+DISCONNECT = 14
+
+MAX_PACKET = 16 * 1024 * 1024
+
+
+def _encode_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = n % 128
+        n //= 128
+        out.append(byte | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+async def _read_varint(reader: asyncio.StreamReader) -> int:
+    mult, value = 1, 0
+    for _ in range(4):
+        (byte,) = await reader.readexactly(1)
+        value += (byte & 0x7F) * mult
+        if not byte & 0x80:
+            return value
+        mult *= 128
+    raise ValueError("malformed remaining-length varint")
+
+
+def _utf8(data: bytes, off: int) -> tuple[str, int]:
+    ln = int.from_bytes(data[off:off + 2], "big")
+    return data[off + 2:off + 2 + ln].decode("utf-8"), off + 2 + ln
+
+
+def _packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + _encode_varint(len(body)) + body
+
+
+class MqttSession:
+    """One connected client."""
+
+    def __init__(self, client_id: str, writer: asyncio.StreamWriter):
+        self.client_id = client_id
+        self.writer = writer
+        self.subscriptions: list[str] = []
+        self.connected_at = time.time()
+
+
+class MqttListener:
+    """The asyncio MQTT endpoint. `on_publish(topic, payload, client_id)`
+    is awaited for every inbound PUBLISH."""
+
+    def __init__(self, on_publish, host: str = "127.0.0.1", port: int = 0):
+        self.on_publish = on_publish
+        self.host, self.port = host, port
+        self.sessions: dict[str, MqttSession] = {}
+        self._conns: set[asyncio.StreamWriter] = set()
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        # close live client connections BEFORE wait_closed: since 3.12,
+        # Server.wait_closed() waits for handlers, and handlers block in
+        # readexactly until their peer socket dies
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._conns):
+            try:
+                w.close()
+            except RuntimeError:
+                pass
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                logger.warning("mqtt: listener handlers did not drain in 5s")
+            self._server = None
+        self.sessions.clear()
+
+    # -- outbound (command delivery) ---------------------------------------
+
+    def matches(self, sub: str, topic: str) -> bool:
+        """MQTT topic filter match (+ single-level, # multi-level)."""
+        sp, tp = sub.split("/"), topic.split("/")
+        for i, s in enumerate(sp):
+            if s == "#":
+                return True
+            if i >= len(tp) or (s != "+" and s != tp[i]):
+                return False
+        return len(sp) == len(tp)
+
+    async def publish_to_subscribers(self, topic: str, payload: bytes) -> int:
+        """QoS0 PUBLISH to every session subscribed to `topic`."""
+        body = len(topic).to_bytes(2, "big") + topic.encode() + payload
+        pkt = _packet(PUBLISH, 0, body)
+        n = 0
+        for s in list(self.sessions.values()):
+            if any(self.matches(sub, topic) for sub in s.subscriptions):
+                try:
+                    s.writer.write(pkt)
+                    await s.writer.drain()
+                    n += 1
+                except (ConnectionError, RuntimeError):
+                    self.sessions.pop(s.client_id, None)
+        return n
+
+    # -- inbound -----------------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        session: Optional[MqttSession] = None
+        self._conns.add(writer)
+        try:
+            while True:
+                (header,) = await reader.readexactly(1)
+                ptype, flags = header >> 4, header & 0x0F
+                length = await _read_varint(reader)
+                if length > MAX_PACKET:
+                    logger.warning("mqtt: packet length %d too large", length)
+                    return
+                body = await reader.readexactly(length) if length else b""
+                if ptype == CONNECT:
+                    session = await self._on_connect(body, writer)
+                elif session is None:
+                    return  # first packet must be CONNECT (spec §3.1)
+                elif ptype == PUBLISH:
+                    await self._on_publish(flags, body, session, writer)
+                elif ptype == SUBSCRIBE:
+                    self._on_subscribe(body, session, writer)
+                elif ptype == UNSUBSCRIBE:
+                    self._on_unsubscribe(body, session, writer)
+                elif ptype == PINGREQ:
+                    writer.write(_packet(PINGRESP, 0, b""))
+                elif ptype == DISCONNECT:
+                    return
+                else:
+                    logger.warning("mqtt: unsupported packet type %d", ptype)
+                    return
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                ValueError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            if session is not None:
+                self.sessions.pop(session.client_id, None)
+            writer.close()
+
+    async def _on_connect(self, body: bytes, writer) -> MqttSession:
+        proto, off = _utf8(body, 0)
+        level = body[off]
+        off += 1  # protocol level (4 for 3.1.1)
+        _connect_flags = body[off]
+        off += 1
+        off += 2  # keepalive
+        client_id, off = _utf8(body, off)
+        if not client_id:
+            client_id = f"anon-{id(writer):x}"
+        session = MqttSession(client_id, writer)
+        self.sessions[client_id] = session
+        accepted = 0 if proto == "MQTT" and level == 4 else 1
+        writer.write(_packet(CONNACK, 0, bytes([0, accepted])))
+        return session
+
+    async def _on_publish(self, flags: int, body: bytes,
+                          session: MqttSession, writer) -> None:
+        qos = (flags >> 1) & 0x3
+        topic, off = _utf8(body, 0)
+        packet_id = None
+        if qos > 0:
+            packet_id = int.from_bytes(body[off:off + 2], "big")
+            off += 2
+        payload = body[off:]
+        await self.on_publish(topic, payload, session.client_id)
+        if qos >= 1 and packet_id is not None:  # QoS2 downgraded to 1
+            writer.write(_packet(PUBACK, 0, packet_id.to_bytes(2, "big")))
+
+    def _on_subscribe(self, body: bytes, session: MqttSession,
+                      writer) -> None:
+        packet_id = int.from_bytes(body[0:2], "big")
+        off = 2
+        codes = bytearray()
+        while off < len(body):
+            topic_filter, off = _utf8(body, off)
+            off += 1  # requested QoS; we grant QoS0
+            session.subscriptions.append(topic_filter)
+            codes.append(0)
+        writer.write(_packet(SUBACK, 0, packet_id.to_bytes(2, "big")
+                             + bytes(codes)))
+
+    def _on_unsubscribe(self, body: bytes, session: MqttSession,
+                        writer) -> None:
+        packet_id = int.from_bytes(body[0:2], "big")
+        off = 2
+        while off < len(body):
+            topic_filter, off = _utf8(body, off)
+            if topic_filter in session.subscriptions:
+                session.subscriptions.remove(topic_filter)
+        writer.write(_packet(UNSUBACK, 0, packet_id.to_bytes(2, "big")))

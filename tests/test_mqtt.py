@@ -1,0 +1,156 @@
+"""MQTT 3.1.1 ingest endpoint (services/mqtt.py): a hand-rolled client
+speaks the real wire protocol — CONNECT/PUBLISH/SUBSCRIBE/PING — and the
+full pipeline ingests its telemetry; command delivery rides the same
+session [SURVEY.md §2.2 event-sources MQTT, command-delivery MQTT]."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.services.mqtt import _encode_varint
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+from tests.test_pipeline import running_pipeline, wait_until
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode()
+    return len(b).to_bytes(2, "big") + b
+
+
+def _pkt(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + _encode_varint(len(body)) + body
+
+
+def connect_pkt(client_id: str) -> bytes:
+    body = _utf8("MQTT") + bytes([4, 2]) + (60).to_bytes(2, "big") \
+        + _utf8(client_id)
+    return _pkt(1, 0, body)
+
+
+def publish_pkt(topic: str, payload: bytes, qos: int = 0,
+                packet_id: int = 1) -> bytes:
+    body = _utf8(topic)
+    if qos:
+        body += packet_id.to_bytes(2, "big")
+    return _pkt(3, qos << 1, body + payload)
+
+
+def subscribe_pkt(topic: str, packet_id: int = 7) -> bytes:
+    return _pkt(8, 2, packet_id.to_bytes(2, "big") + _utf8(topic) + b"\x00")
+
+
+async def read_pkt(reader) -> tuple[int, int, bytes]:
+    (h,) = await reader.readexactly(1)
+    mult, length = 1, 0
+    while True:
+        (b,) = await reader.readexactly(1)
+        length += (b & 0x7F) * mult
+        if not b & 0x80:
+            break
+        mult *= 128
+    body = await reader.readexactly(length) if length else b""
+    return h >> 4, h & 0x0F, body
+
+
+def test_mqtt_ingest_and_command_roundtrip(run):
+    async def main():
+        from sitewhere_tpu.domain.events import DeviceCommandInvocation
+        from sitewhere_tpu.domain.model import DeviceCommand
+        from sitewhere_tpu.services import CommandDeliveryService
+
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"}]},
+            "rule-processing": {"model": None},
+            "command-delivery": {"provider": "mqtt", "encoder": "json"},
+        }
+        async with running_pipeline(num_devices=20, sections=sections,
+                                    extra_services=(CommandDeliveryService,)) \
+                as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            port = receiver.port
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+            # CONNECT → CONNACK accepted
+            writer.write(connect_pkt("dev-7"))
+            await writer.drain()
+            ptype, _, body = await read_pkt(reader)
+            assert ptype == 2 and body[1] == 0
+
+            # SUBSCRIBE to this device's command topic → SUBACK
+            dm = rt.api("device-management").management("acme")
+            device = dm.get_device_by_token("dev-7")
+            writer.write(subscribe_pkt("swx/commands/dev-7"))
+            await writer.drain()
+            ptype, _, body = await read_pkt(reader)
+            assert ptype == 9
+
+            # PUBLISH telemetry (QoS1) → PUBACK + pipeline ingest
+            sim = DeviceSimulator(SimConfig(num_devices=20), tenant_id="acme")
+            for k in range(3):
+                payload, _ = sim.payload(t=60.0 * k)
+                writer.write(publish_pkt("swx/telemetry", payload, qos=1,
+                                         packet_id=10 + k))
+                await writer.drain()
+                ptype, _, body = await read_pkt(reader)
+                assert ptype == 4  # PUBACK
+
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 60)
+
+            # command invocation routes back down the SAME mqtt session
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="reboot", device_type_id=dt.id, name="reboot"))
+            assignment = dm.get_active_assignments_for_device(device.id)[0]
+            inv = DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id, parameter_values={"delay": 1})
+            await em.add_command_invocations([inv])
+            ptype, flags, body = await read_pkt(reader)
+            assert ptype == 3  # PUBLISH down to the device
+            tlen = int.from_bytes(body[:2], "big")
+            topic = body[2:2 + tlen].decode()
+            assert topic == "swx/commands/dev-7"
+            assert b"reboot" in body[2 + tlen:]
+
+            # PINGREQ → PINGRESP keeps the session alive
+            writer.write(_pkt(12, 0, b""))
+            await writer.drain()
+            ptype, _, _ = await read_pkt(reader)
+            assert ptype == 13
+            writer.close()
+
+    run(main())
+
+
+def test_mqtt_rejects_garbage_and_survives(run):
+    async def main():
+        sections = {"event-sources": {"receivers": [
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"}]},
+            "rule-processing": {"model": None}}
+        async with running_pipeline(num_devices=5, sections=sections) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            # a client that speaks garbage gets dropped without killing
+            # the listener
+            r1, w1 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w1.write(b"\xff\xff\xff\xff\xff\xff")
+            await w1.drain()
+            # a well-behaved client still connects fine afterwards
+            r2, w2 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w2.write(connect_pkt("ok"))
+            await w2.drain()
+            ptype, _, body = await read_pkt(r2)
+            assert ptype == 2 and body[1] == 0
+            # garbage PUBLISH payload counts a decode failure, not a crash
+            w2.write(publish_pkt("t", b"not swb1"))
+            await w2.drain()
+            await wait_until(lambda: rt.metrics.snapshot()
+                             ["event_sources.decode_failures"] >= 1)
+            w1.close()
+            w2.close()
+
+    run(main())

@@ -24,7 +24,8 @@ from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
 
 
 @contextlib.asynccontextmanager
-async def running_pipeline(num_devices: int = 100, sections: dict | None = None):
+async def running_pipeline(num_devices: int = 100, sections: dict | None = None,
+                           extra_services: tuple = ()):
     """Started runtime with tenant 'acme' and a registered fleet."""
     from sitewhere_tpu.services import RuleProcessingService
 
@@ -36,6 +37,8 @@ async def running_pipeline(num_devices: int = 100, sections: dict | None = None)
     rt.add_service(DeviceStateService(rt))
     if sections and "rule-processing" in sections:
         rt.add_service(RuleProcessingService(rt))
+    for cls in extra_services:
+        rt.add_service(cls(rt))
     await rt.start()
     await rt.add_tenant(TenantConfig(tenant_id="acme", sections=sections or {}))
     dm = rt.api("device-management").management("acme")
