@@ -1,4 +1,4 @@
-"""Benchmark: full-pipeline scored-events throughput + p99 latency.
+"""Benchmark: full-pipeline scored-events throughput + decomposed p99.
 
 The judge's metric [BASELINE.json]: device-events/sec scored and p99
 per-event inference latency. This drives the REAL pipeline — simulator
@@ -10,9 +10,20 @@ arrival).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 vs_baseline is value / 1e6 (the north-star ≥1M events/s target; the
-reference publishes no numbers — BASELINE.md).
+reference publishes no numbers — BASELINE.md). On ANY failure the line
+still prints, with an "error" field — a broken backend must never leave
+the round without a parseable artifact.
 
-Usage: python bench.py [--model lstm|zscore] [--devices N] [--seconds S]
+Extra honesty fields:
+  p99_breakdown  per-stage p50/p99 (admit → batch → device → sink) for
+                 the paced-latency phase, so the tail is decomposable
+                 into pipeline-hop vs batching vs XLA-queue/sync time
+  mfu            achieved model FLOP/s ÷ chip peak bf16 FLOP/s
+  drain          whether each phase's drain finished inside its timeout
+                 (a timed-out drain contaminates that phase's stats)
+
+Usage: python bench.py [--model lstm|zscore|tft|longwin] [--devices N]
+                       [--seconds S] [--profile DIR]
 """
 
 from __future__ import annotations
@@ -22,13 +33,46 @@ import asyncio
 import json
 import sys
 import time
+import traceback
+
+# chip peak bf16 FLOP/s by device_kind substring (public spec sheets);
+# unknown kinds (incl. CPU) → no MFU reported rather than a made-up one
+PEAK_BF16_FLOPS = (
+    ("v5 lite", 197e12),   # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),   # v6e / Trillium
+    ("v6e", 918e12),
+    ("v4", 275e12),
+)
+
+
+def probe_backend(retries: int = 4, base_delay: float = 2.0):
+    """Fail fast (and retryably) on a broken accelerator backend BEFORE
+    building the whole runtime: list devices and run one tiny computation
+    end to end. Returns (platform, device_kind, n_chips)."""
+    last = None
+    for attempt in range(retries):
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            devs = jax.devices()
+            x = jnp.ones((8, 8))
+            (x @ x).block_until_ready()
+            return devs[0].platform, devs[0].device_kind, len(devs)
+        except Exception as exc:  # noqa: BLE001 - probe failure is data
+            last = exc
+            if attempt < retries - 1:
+                time.sleep(base_delay * (2 ** attempt))
+    raise RuntimeError(f"accelerator backend probe failed after "
+                       f"{retries} attempts: {last!r}") from last
 
 
 async def run_bench(args) -> dict:
     import os
 
     import jax
-    import numpy as np
 
     # persistent compile cache: repeat bench runs skip the 20-40s first-compile
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -52,7 +96,10 @@ async def run_bench(args) -> dict:
     )
     from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
 
-    rt = ServiceRuntime(InstanceSettings(instance_id="bench"))
+    platform, device_kind, n_chips = probe_backend()
+
+    rt = ServiceRuntime(InstanceSettings(
+        instance_id="bench", engine_ready_timeout_s=args.ready_timeout))
     for cls in (DeviceManagementService, EventSourcesService,
                 InboundProcessingService, EventManagementService,
                 DeviceStateService, RuleProcessingService):
@@ -67,6 +114,7 @@ async def run_bench(args) -> dict:
             "batch_window_ms": args.window_ms,
             "buckets": [args.devices],  # fleet-sized bucket: 1 flush = 1 XLA call
             "capacity": args.devices,   # pre-size the device ring: no regrow
+            "max_inflight": args.max_inflight,
         },
     }))
     dm = rt.api("device-management").management("bench")
@@ -86,13 +134,13 @@ async def run_bench(args) -> dict:
 
     receiver = rt.api("event-sources").engine("bench").receiver("default")
     session = rt.api("rule-processing").engine("bench").session
-    scored_meter = session.scored_meter
     # wait for background warmup (bucket compiles) before measuring
     t_warm = time.monotonic()
     while not session.ready:
         await asyncio.sleep(0.1)
-        if time.monotonic() - t_warm > 300:
-            raise TimeoutError("scoring warmup did not finish in 300s")
+        if time.monotonic() - t_warm > args.ready_timeout:
+            raise TimeoutError(
+                f"scoring warmup did not finish in {args.ready_timeout}s")
     # the warm history above entered the store directly (not via the
     # pipeline), so sync the device-resident ring from it
     session.reload_history()
@@ -121,10 +169,13 @@ async def run_bench(args) -> dict:
         sent += args.devices
         k += 1
     # drain: wait until every sent event is scored and settled
-    deadline = time.monotonic() + 60.0
+    t_drain = time.monotonic()
+    deadline = t_drain + args.drain_timeout
     while ((lat_hist.count < sent or session.inflight > 0)
            and time.monotonic() < deadline):
         await asyncio.sleep(0.05)
+    sat_drain_s = time.monotonic() - t_drain
+    sat_drain_ok = lat_hist.count >= sent and session.inflight == 0
     elapsed = time.monotonic() - t0
     if args.profile:
         jax.profiler.stop_trace()
@@ -137,6 +188,9 @@ async def run_bench(args) -> dict:
     paced_rate = args.paced_fraction * rate
     interval = args.devices / max(paced_rate, 1.0)
     lat_hist.reset()
+    for h in (session.stage_admit, session.stage_batch,
+              session.stage_device, session.stage_sink):
+        h.reset()  # breakdown describes the paced window only
     t1 = time.monotonic()
     paced_sent = 0
     next_t = t1
@@ -148,16 +202,43 @@ async def run_bench(args) -> dict:
         delay = next_t - time.monotonic()
         if delay > 0:
             await asyncio.sleep(delay)
-    deadline = time.monotonic() + 30.0
+    t_drain = time.monotonic()
+    deadline = t_drain + args.latency_drain_timeout
     while ((lat_hist.count < paced_sent or session.inflight > 0)
            and time.monotonic() < deadline):
         await asyncio.sleep(0.05)
+    lat_drain_s = time.monotonic() - t_drain
+    lat_drain_ok = lat_hist.count >= paced_sent and session.inflight == 0
+
+    if args.debug_stages:
+        import pprint
+        print("--- stage summary (sampled spans) ---", file=sys.stderr)
+        pprint.pprint(rt.tracer.stage_summary(), stream=sys.stderr)
+        snap = rt.metrics.snapshot()
+        pprint.pprint({k: v for k, v in snap.items()
+                       if "meter" in k or "events" in k or "scoring" in k},
+                      stream=sys.stderr)
 
     p99 = lat_hist.quantile(0.99)
     p50 = lat_hist.quantile(0.50)
+    breakdown = {}
+    for nm, h in (("admit", session.stage_admit),
+                  ("batch", session.stage_batch),
+                  ("device", session.stage_device),
+                  ("sink", session.stage_sink)):
+        breakdown[nm] = {"p50_ms": round(h.quantile(0.5) * 1e3, 3),
+                         "p99_ms": round(h.quantile(0.99) * 1e3, 3)}
+
+    # MFU: achieved model FLOP/s at the saturation rate vs chip peak
+    flops_ev = float(getattr(session.model, "flops_per_event",
+                             lambda: 0.0)())
+    model_flops_s = rate * flops_ev
+    kind_l = device_kind.lower()
+    peak = next((v for k_, v in PEAK_BF16_FLOPS if k_ in kind_l), None)
+    mfu = (model_flops_s / (peak * n_chips)) if peak else None
+
     await rt.stop()
 
-    import jax
     return {
         "metric": "pipeline_scored_events_per_sec",
         "value": round(rate, 1),
@@ -165,29 +246,68 @@ async def run_bench(args) -> dict:
         "vs_baseline": round(rate / 1_000_000, 4),
         "p99_ms": round(p99 * 1e3, 3),
         "p50_ms": round(p50 * 1e3, 3),
+        "p99_breakdown": breakdown,
         "paced_rate": round(paced_rate, 1),
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),
         "model": args.model,
-        "devices": args.devices,
-        "platform": jax.devices()[0].platform,
+        "model_flops_per_event": flops_ev,
+        "model_tflops": round(model_flops_s / 1e12, 3),
+        "mfu": round(mfu, 5) if mfu is not None else None,
+        "fleet_devices": args.devices,
+        "chips": n_chips,
+        "device_kind": device_kind,
+        "platform": platform,
+        "drain": {"saturation_complete": sat_drain_ok,
+                  "saturation_seconds": round(sat_drain_s, 2),
+                  "latency_complete": lat_drain_ok,
+                  "latency_seconds": round(lat_drain_s, 2)},
     }
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="lstm", choices=["lstm", "zscore"])
+    parser.add_argument("--model", default="lstm-stream",
+                        choices=["lstm", "lstm-stream", "zscore", "tft",
+                                 "longwin"])
     parser.add_argument("--devices", type=int, default=16384)
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--window", type=int, default=64)
     parser.add_argument("--window-ms", type=float, default=2.0)
     parser.add_argument("--history", type=int, default=256)
     parser.add_argument("--latency-seconds", type=float, default=5.0)
-    parser.add_argument("--paced-fraction", type=float, default=0.7)
+    parser.add_argument("--paced-fraction", type=float, default=0.5,
+                        help="phase-2 offered load as a fraction of the "
+                             "measured saturation rate; 0.5 keeps queues "
+                             "near-empty so the p99 is the system's, not "
+                             "the backlog's")
+    parser.add_argument("--max-inflight", type=int, default=8,
+                        help="dispatched-not-settled flush bound; small "
+                             "values cap XLA queue depth (tail latency), "
+                             "large ones maximize pipelining")
+    parser.add_argument("--drain-timeout", type=float, default=60.0,
+                        help="phase-1 drain bound; a timeout marks the "
+                             "run's drain.saturation_complete false")
+    parser.add_argument("--latency-drain-timeout", type=float, default=30.0)
+    parser.add_argument("--ready-timeout", type=float, default=300.0,
+                        help="engine/warmup readiness bound (first TPU "
+                             "compiles over a tunnel take minutes)")
     parser.add_argument("--profile", default=None, metavar="DIR",
                         help="write a jax.profiler trace of phase 1 to DIR")
+    parser.add_argument("--debug-stages", action="store_true",
+                        help="dump sampled per-stage span stats to stderr")
     args = parser.parse_args()
-    result = asyncio.run(run_bench(args))
+    try:
+        result = asyncio.run(run_bench(args))
+    except BaseException as exc:  # noqa: BLE001 - the artifact must parse
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "pipeline_scored_events_per_sec",
+            "value": 0.0, "unit": "events/s", "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}",
+            "model": args.model, "fleet_devices": args.devices,
+        }))
+        sys.exit(1)
     print(json.dumps(result))
 
 

@@ -38,6 +38,9 @@ class InstanceSettings:
     trace_sample: int = 64     # record spans for every Nth trace [SURVEY §5.1]
     scoring_batch_window_ms: float = 2.0
     scoring_batch_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
+    # engine spin-up bound: first TPU compiles over a tunneled chip can
+    # take minutes — the old 60 s default killed whole bench runs
+    engine_ready_timeout_s: float = 300.0
     # log level
     log_level: str = "INFO"
 

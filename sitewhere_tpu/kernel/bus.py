@@ -49,18 +49,29 @@ class TopicRecord:
 
 
 class _PartitionLog:
-    """Append-only log for one partition, with bounded retention."""
+    """Append-only log for one partition, with bounded retention.
 
-    __slots__ = ("records", "base_offset", "cond")
+    Waiters are per-consumer `asyncio.Event`s registered by `poll` on
+    EVERY assigned partition, so a consumer owning several partitions
+    wakes on the first record to arrive on any of them (the old
+    one-condition-per-poll design degraded to a 50 ms re-check loop for
+    multi-partition assignments — wake-up jitter that landed directly in
+    the paced-p99 measurement)."""
+
+    __slots__ = ("records", "base_offset", "waiters")
 
     def __init__(self) -> None:
         self.records: list[tuple[Optional[str], Any, float]] = []
         self.base_offset = 0  # offset of records[0]
-        self.cond = asyncio.Condition()
+        self.waiters: set[asyncio.Event] = set()
 
     @property
     def end_offset(self) -> int:
         return self.base_offset + len(self.records)
+
+    def notify(self) -> None:
+        for w in self.waiters:
+            w.set()
 
     def trim(self, retain: int) -> None:
         excess = len(self.records) - retain
@@ -103,6 +114,8 @@ class _GroupState:
         for member in self.members:
             member._positions = {}  # re-fetch from committed on next poll
             member._generation = self.generation
+            if member._wake is not None:
+                member._wake.set()  # re-register waiters on the new assignment
 
 
 class EventBus(LifecycleComponent):
@@ -149,11 +162,10 @@ class EventBus(LifecycleComponent):
         topic = self._topics[topic_name]
         p = partition if partition is not None else self._select_partition(topic, key)
         log = topic.partitions[p]
-        async with log.cond:
-            offset = log.end_offset
-            log.records.append((key, value, time.time()))
-            log.trim(topic.retention)
-            log.cond.notify_all()
+        offset = log.end_offset
+        log.records.append((key, value, time.time()))
+        log.trim(topic.retention)
+        log.notify()
         return p, offset
 
     def produce_nowait(self, topic_name: str, value: Any, *,
@@ -171,11 +183,11 @@ class EventBus(LifecycleComponent):
         log.records.append((key, value, time.time()))
         log.trim(topic.retention)
         try:
-            loop = asyncio.get_running_loop()
+            asyncio.get_running_loop()
         except RuntimeError:
-            loop = None
-        if loop is not None:
-            loop.call_soon(_notify_cond, log.cond)
+            pass  # no loop running in this thread: no waiter can exist on it
+        else:
+            log.notify()
         return p, offset
 
     # -- consume -----------------------------------------------------------
@@ -204,16 +216,7 @@ class EventBus(LifecycleComponent):
         # wake all pollers so closing consumers notice shutdown promptly
         for topic in self._topics.values():
             for log in topic.partitions:
-                async with log.cond:
-                    log.cond.notify_all()
-
-
-def _notify_cond(cond: asyncio.Condition) -> None:
-    # fire-and-forget notify from sync context
-    async def _n() -> None:
-        async with cond:
-            cond.notify_all()
-    asyncio.ensure_future(_n())
+                log.notify()
 
 
 class BusConsumer:
@@ -233,6 +236,7 @@ class BusConsumer:
         self._positions: dict[tuple[str, int], int] = {}
         self._generation = -1
         self._closed = False
+        self._wake: Optional[asyncio.Event] = None  # set while poll waits
 
     @property
     def assignment(self) -> tuple[tuple[str, int], ...]:
@@ -286,26 +290,32 @@ class BusConsumer:
         records = self.poll_nowait(max_records)
         if records or self._closed:
             return records
-        # wait on the first assigned partition's condition; producers notify
-        # per-partition, so with multiple assigned partitions poll degrades to
-        # a short re-check loop (fine: record arrival is the common wake).
+        # register one wake event on EVERY assigned partition: the first
+        # record to land on any of them (or a rebalance/close) wakes us
         deadline = time.monotonic() + timeout
         while not records and not self._closed:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             if not self._assignment:
+                # unassigned (more members than partitions): a rebalance is
+                # the only thing that could change that — cheap re-check
                 await asyncio.sleep(min(remaining, 0.05))
             else:
-                topic_name, p = self._assignment[0]
-                log = self._bus._topics[topic_name].partitions[p]
-                async with log.cond:
-                    try:
-                        await asyncio.wait_for(
-                            log.cond.wait(),
-                            min(remaining, 0.05 if len(self._assignment) > 1 else remaining))
-                    except asyncio.TimeoutError:
-                        pass
+                ev = asyncio.Event()
+                self._wake = ev
+                logs = [self._bus._topics[t].partitions[p]
+                        for t, p in self._assignment]
+                for log in logs:
+                    log.waiters.add(ev)
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    self._wake = None
+                    for log in logs:
+                        log.waiters.discard(ev)
             records = self.poll_nowait(max_records)
         return records
 
@@ -336,6 +346,8 @@ class BusConsumer:
         if not self._closed:
             self._closed = True
             self._bus._leave(self)
+            if self._wake is not None:
+                self._wake.set()  # a poll blocked in wait returns promptly
 
 
 class TopicNaming:

@@ -272,9 +272,14 @@ class ServiceRuntime(LifecycleComponent):
         await self._await_engines(tenant_id, present=False)
 
     async def _await_engines(self, tenant_id: str, *, present: bool = True,
-                             timeout: float = 60.0) -> None:
-        # default is generous: engine start may include TPU warm-up compiles
-        """Block until every multitenant service has (or drops) the engine."""
+                             timeout: Optional[float] = None) -> None:
+        """Block until every multitenant service has (or drops) the engine.
+
+        Default bound comes from `InstanceSettings.engine_ready_timeout_s`
+        (generous: engine start may include TPU warm-up compiles that take
+        minutes over a tunneled chip)."""
+        if timeout is None:
+            timeout = self.settings.engine_ready_timeout_s
         deadline = asyncio.get_event_loop().time() + timeout
         multitenant = [s for s in self.services.values()
                        if s.multitenant and s.status == LifecycleStatus.STARTED]

@@ -181,6 +181,15 @@ class LongWindowModel:
         enough = v.sum(-1) >= cfg.min_history
         return jnp.clip(jnp.where(enough, score, 0.0), 0.0, cfg.score_clip)
 
+    def flops_per_event(self) -> float:
+        """Approximate forward FLOPs per scored window: per layer, the
+        MLP/projection matmuls (~8 d*d per step) plus blockwise attention
+        (4*W*d per step). Coarse estimate for MFU accounting."""
+        cfg = self.cfg
+        d, w = cfg.hidden, cfg.window
+        per_layer = w * (8.0 * d * d + 4.0 * w * d)
+        return cfg.layers * per_layer
+
     def loss(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
         """Pinball (quantile) loss of each position's next-step
         prediction against the realized value, masked to valid pairs."""

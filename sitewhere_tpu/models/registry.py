@@ -10,12 +10,17 @@ from __future__ import annotations
 from typing import Any
 
 from sitewhere_tpu.models.longwin import LongWindowConfig, LongWindowModel
-from sitewhere_tpu.models.lstm import LstmAnomalyModel, LstmConfig
+from sitewhere_tpu.models.lstm import (
+    LstmAnomalyModel,
+    LstmConfig,
+    StreamingLstmModel,
+)
 from sitewhere_tpu.models.tft import TftConfig, TftForecaster
 from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
 
 MODEL_REGISTRY: dict[str, tuple[type, type]] = {
     "lstm": (LstmConfig, LstmAnomalyModel),
+    "lstm-stream": (LstmConfig, StreamingLstmModel),
     "tft": (TftConfig, TftForecaster),
     "zscore": (ZScoreConfig, ZScoreModel),
     "longwin": (LongWindowConfig, LongWindowModel),

@@ -337,6 +337,17 @@ class TftForecaster:
         enough &= vt.sum(-1) > 0
         return jnp.clip(jnp.where(enough, score, 0.0), 0.0, cfg.score_clip)
 
+    def flops_per_event(self) -> float:
+        """Approximate forward FLOPs per scored window: VSN + GRN stack
+        (~a dozen d*d matmuls per step), encoder/decoder LSTMs, and the
+        interpretable attention (QK^T + AV over the full window). A
+        coarse estimate for MFU accounting, not a profiler."""
+        cfg = self.cfg
+        d, w = cfg.hidden, cfg.window
+        per_step = 24.0 * d * d + 16.0 * d * d  # GRN stack + LSTM gates
+        attn = 4.0 * w * w * d / max(w, 1)      # amortized per step
+        return w * (per_step + attn)
+
     def loss(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
         """Masked quantile (pinball) loss over the horizon region."""
         cfg = self.cfg

@@ -45,5 +45,9 @@ class ZScoreModel:
         enough = v.sum(-1) >= self.cfg.min_history
         return jnp.clip(jnp.where(enough, score, 0.0), 0.0, self.cfg.score_clip)
 
+    def flops_per_event(self) -> float:
+        """~8 elementwise FLOPs per window step (masked mean/var/score)."""
+        return 8.0 * self.cfg.window
+
     def loss(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
         return jnp.zeros(())  # nothing to train

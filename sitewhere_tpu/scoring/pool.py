@@ -67,7 +67,6 @@ class _TenantEntry:
         field(default_factory=list)  # (device_index, value, ts, ingest, ctx)
     pending_n: int = 0
     inflight: int = 0          # this tenant's share of in-flight flushes
-    ctx: Optional[BatchContext] = None
 
 
 class TenantSlot:
@@ -97,6 +96,12 @@ class TenantSlot:
     def pending_n(self) -> int:
         entry = self.pool.tenants.get(self.tenant_id)
         return entry.pending_n if entry is not None else 0
+
+    @property
+    def backlogged(self) -> bool:
+        """This tenant's admission backlog is at capacity; its consumer
+        must pause polling (backpressure, not post-consume drops)."""
+        return self.pending_n >= 16 * self.pool.cfg.batch_buckets[-1]
 
     @property
     def inflight(self) -> int:
@@ -286,16 +291,8 @@ class SharedScoringPool:
         entry.pending_n += dev.shape[0]
         if dev.shape[0]:
             self._pending_max = max(self._pending_max, int(dev.max()))
-        entry.ctx = batch.ctx
         if self._deadline is None:
             self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
-        # bound the backlog (compiles, regrows, sustained overload):
-        # drop-oldest with a metric beats unbounded growth/OOM
-        cap = 16 * self.cfg.batch_buckets[-1]
-        while entry.pending_n > cap and len(entry.pending) > 1:
-            old = entry.pending.pop(0)
-            entry.pending_n -= old[0].shape[0]
-            self.dropped.inc(old[0].shape[0])
         self._wake.set()
 
     # -- flushing -----------------------------------------------------------
@@ -348,39 +345,48 @@ class SharedScoringPool:
         schedule the settle. Leftovers re-queue (the wake stays set so
         the next round follows immediately)."""
         takes: dict[str, tuple] = {}
-        max_dev = 0
         for tid, e in self.tenants.items():
             if e.pending_n == 0:
                 continue
-            dev = np.concatenate([p[0] for p in e.pending])
-            val = np.concatenate([p[1] for p in e.pending])
-            ts = np.concatenate([p[2] for p in e.pending])
-            ing = np.concatenate([p[3] for p in e.pending])
-            cut = min(dev.shape[0], self.cfg.batch_buckets[-1])
-            # score spans attribute to each admitted batch's trace; on a
-            # partial take, split at the cut (the tail re-queues and gets
-            # its span next round)
+            # take whole admitted batches up to the bucket budget; split
+            # only the boundary batch — its tail re-queues WITH ITS OWN
+            # ctx (the old concat-then-cut requeued the tail under the
+            # last batch's ctx, misattributing tenant/source/trace for
+            # every earlier batch's leftover events)
+            taken: list[tuple] = []
             traces = []
-            remaining = cut
-            for p in e.pending:
-                k = min(p[0].shape[0], remaining)
-                if k > 0:
-                    traces.append((p[4].trace_id, k))
-                    remaining -= k
-                if remaining == 0:
-                    break
-            if cut < dev.shape[0]:
-                e.pending = [(dev[cut:], val[cut:], ts[cut:], ing[cut:],
-                              e.pending[-1][4])]
-                e.pending_n = dev.shape[0] - cut
+            budget = self.cfg.batch_buckets[-1]
+            while e.pending and budget > 0:
+                p = e.pending[0]
+                n = p[0].shape[0]
+                if n <= budget:
+                    e.pending.pop(0)
+                    taken.append(p)
+                    traces.append((p[4].trace_id, n))
+                    budget -= n
+                else:
+                    head = tuple(c[:budget] for c in p[:4]) + (p[4],)
+                    e.pending[0] = tuple(c[budget:] for c in p[:4]) + (p[4],)
+                    taken.append(head)
+                    traces.append((p[4].trace_id, budget))
+                    budget = 0
+            e.pending_n = sum(p[0].shape[0] for p in e.pending)
+            if e.pending_n:
                 self._wake.set()
                 if self._deadline is None:
                     self._deadline = time.monotonic()
-            else:
-                e.pending, e.pending_n = [], 0
-            takes[tid] = (dev[:cut], val[:cut], ts[:cut], ing[:cut], traces)
-            if cut:
-                max_dev = max(max_dev, int(dev[:cut].max()))
+            dev = np.concatenate([p[0] for p in taken])
+            val = np.concatenate([p[1] for p in taken])
+            ts = np.concatenate([p[2] for p in taken])
+            ing = np.concatenate([p[3] for p in taken])
+            # the take's delivery ctx: exact when one batch, merged
+            # sources when several (same convention as the dedicated
+            # session's _take_pending)
+            sources = {p[4].source for p in taken}
+            ctx = taken[0][4] if len(sources) == 1 else BatchContext(
+                tenant_id=tid, source="+".join(sorted(sources)),
+                ingest_monotonic=min(p[4].ingest_monotonic for p in taken))
+            takes[tid] = (dev, val, ts, ing, traces, ctx)
         if self._total_pending == 0:
             self._pending_max = -1
         if not takes:
@@ -388,9 +394,9 @@ class SharedScoringPool:
         t_cap, d_cap = self.ring.t_cap, self.ring.device_cap
 
         # split every tenant's take into occurrence rounds
-        metas = []  # (tid, slot, n, dev, ts, ing, traces, ev_rounds)
+        metas = []  # (tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx)
         round_parts: list[list[tuple[int, np.ndarray, np.ndarray]]] = []
-        for tid, (dev, val, ts, ing, traces) in takes.items():
+        for tid, (dev, val, ts, ing, traces, ctx) in takes.items():
             slot = self.stack.slots[tid]
             n = dev.shape[0]
             counts = np.unique(dev, return_counts=True)[1] if n else np.array([1])
@@ -410,7 +416,7 @@ class SharedScoringPool:
                     round_parts.append([])
                 round_parts[r].append((slot, rdev, rval))
                 ev_rounds.append((r, rpos, rdev.shape[0]))
-            metas.append((tid, slot, n, dev, ts, ing, traces, ev_rounds))
+            metas.append((tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx))
 
         t0 = time.monotonic()
         dispatches = []
@@ -456,7 +462,7 @@ class SharedScoringPool:
                 raise
             now = time.monotonic()
             self.batch_latency.observe(now - t0)
-            for tid, slot, n, dev, ts, ing, traces, ev_rounds in metas:
+            for tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx in metas:
                 e = self.tenants.get(tid)
                 if e is None:  # unregistered mid-flight
                     continue
@@ -472,7 +478,6 @@ class SharedScoringPool:
                 n_anom = int(is_anom.sum())
                 if n_anom:
                     self.anomalies.inc(n_anom)
-                ctx = e.ctx or BatchContext(tenant_id=tid, source="pool")
                 scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
                                      model_version=self.stack.versions[tid])
                 if self.tracer is not None:

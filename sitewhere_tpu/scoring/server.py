@@ -79,9 +79,8 @@ class ScoringSession:
             params if params is not None
             else model.init(jax.random.PRNGKey(cfg.seed)))
         self.version = 0
-        w = model.cfg.window
         host = telemetry.channels.get(cfg.mtype)
-        self.ring = DeviceRing(w, capacity=max(
+        self.ring = self._new_ring(max(
             cfg.capacity, host.capacity if host else 0, 1024))
         self._fns: dict[int, Callable] = {}   # score_devices query path
         # False while warmup compiles buckets; flushes are held (admission
@@ -95,9 +94,10 @@ class ScoringSession:
         self.settled_count = 0
         self._outstanding: set[int] = set()   # dispatched, not yet settled
         self._regrow_task: Optional[asyncio.Task] = None
-        # pending admission state
+        # pending admission state:
+        # (device_index, value, ts, ingest, ctx, admit_monotonic)
         self._pending: list[tuple[np.ndarray, np.ndarray, np.ndarray,
-                                  np.ndarray, BatchContext]] = []
+                                  np.ndarray, BatchContext, float]] = []
         self._pending_n = 0
         self._pending_max = -1      # highest device index waiting
         self._deadline: Optional[float] = None
@@ -110,6 +110,29 @@ class ScoringSession:
         self.anomalies = metrics.counter("scoring.anomalies_detected")
         self.dropped = metrics.counter("scoring.admissions_dropped")
         self.sink_failures = metrics.counter("scoring.sink_failures")
+        # end-to-end latency decomposition (one observation per batch or
+        # per flush — negligible overhead, and the p99 stops being a
+        # single opaque number):
+        #   admit  = receiver arrival → admission (decode + bus hops + queue)
+        #   batch  = admission → dispatch (deadline batching + inflight gate)
+        #   device = dispatch → scores on host (XLA queue + compute + sync)
+        #   sink   = settled → published (delivery/alert fan-out)
+        self.stage_admit = metrics.histogram("scoring.stage_admit_s")
+        self.stage_batch = metrics.histogram("scoring.stage_batch_s")
+        self.stage_device = metrics.histogram("scoring.stage_device_s")
+        self.stage_sink = metrics.histogram("scoring.stage_sink_s")
+
+    def _new_ring(self, capacity: int):
+        """Window ring (raw history, per-event window rescore) or
+        streaming ring (resident model state, one step per event) —
+        the model declares which hot path it wants."""
+        if getattr(self.model, "streaming", False):
+            from sitewhere_tpu.scoring.stream import StreamingRing
+
+            ring = StreamingRing(self.model, capacity=capacity)
+            ring.bind_params(self.params)
+            return ring
+        return DeviceRing(self.model.cfg.window, capacity=capacity)
 
     # -- warmup / params ---------------------------------------------------
 
@@ -154,8 +177,7 @@ class ScoringSession:
                     await asyncio.sleep(0.01)
 
         def recover():
-            self.ring = DeviceRing(self.model.cfg.window,
-                                   capacity=self.ring.capacity)
+            self.ring = self._new_ring(self.ring.capacity)
 
         await retry_backoff(attempt, recover, logger, "scoring warmup")
         self.ready = True
@@ -179,6 +201,12 @@ class ScoringSession:
     def swap_params(self, new_params: dict) -> int:
         """Hot-swap trained params (checkpoint rollout); bumps version."""
         self.params = jax.device_put(new_params)
+        if hasattr(self.ring, "bind_params"):
+            # streaming state (h/c/pred) is a function of the weights —
+            # carrying old-weight state into new-weight steps mis-scores
+            # every device until it washes out. Reseed from host history.
+            self.ring.bind_params(self.params)
+            self._load_ring()
         self.version += 1
         return self.version
 
@@ -250,24 +278,28 @@ class ScoringSession:
                             batch.ts[mask])
         if dev.shape[0] == 0:
             return
+        now = time.monotonic()
+        self.stage_admit.observe(now - batch.ctx.ingest_monotonic)
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
-        self._pending.append((dev, val, ts, ingest, batch.ctx))
+        self._pending.append((dev, val, ts, ingest, batch.ctx, now))
         self._pending_n += dev.shape[0]
         if dev.shape[0]:
             self._pending_max = max(self._pending_max, int(dev.max()))
         if self._deadline is None:
             self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
-        # bound the backlog (warmup compiles, regrows, sustained overload):
-        # drop-oldest with a metric beats unbounded growth/OOM
-        cap = 16 * self.cfg.buckets[-1]
-        while self._pending_n > cap and len(self._pending) > 1:
-            old = self._pending.pop(0)
-            self._pending_n -= old[0].shape[0]
-            self.dropped.inc(old[0].shape[0])
 
     @property
     def pending_n(self) -> int:
         return self._pending_n
+
+    @property
+    def backlogged(self) -> bool:
+        """Admission backlog is at capacity (warmup compiles, regrows,
+        sustained overload). The CONSUMER must stop polling while this
+        holds — backpressure through uncommitted bus offsets preserves
+        the documented at-least-once guarantee; silently dropping events
+        that were already consumed (the old drop-oldest) did not."""
+        return self._pending_n >= 16 * self.cfg.buckets[-1]
 
     @property
     def idle(self) -> bool:
@@ -310,6 +342,9 @@ class ScoringSession:
         pending, self._pending = self._pending, []
         self._pending_n, self._deadline = 0, None
         self._pending_max = -1
+        now = time.monotonic()
+        for p in pending:  # batching stage: admission → dispatch
+            self.stage_batch.observe(now - p[5])
         dev = np.concatenate([p[0] for p in pending])
         val = np.concatenate([p[1] for p in pending]).astype(np.float32, copy=False)
         ts = np.concatenate([p[2] for p in pending])
@@ -354,6 +389,13 @@ class ScoringSession:
             bucket = self._bucket_for(rdev.shape[0])
             scores_dev = self.ring.update_and_score(
                 self.model, self.params, rdev, rval, bucket)
+            # start the device→host DMA NOW (non-blocking): by the time a
+            # settle thread calls np.asarray the bytes are en route, so
+            # the settle holds the GIL for a memcpy, not a device sync
+            try:
+                scores_dev.copy_to_host_async()
+            except AttributeError:
+                pass
             self.batch_size_hist.observe(float(rdev.shape[0]))
             dispatches.append((scores_dev, rdev.shape[0], rpos))
         return dispatches
@@ -391,6 +433,7 @@ class ScoringSession:
                 else:
                     scores[rpos] = scores_u[:n]
             now = time.monotonic()
+            self.stage_device.observe(now - t0)
             self.scored_meter.mark(dev.shape[0])
             self.latency.observe_array(now - ingest)
             self.batch_latency.observe(now - t0)
@@ -413,6 +456,8 @@ class ScoringSession:
                 except Exception:  # noqa: BLE001 - sink errors can't kill settles
                     self.sink_failures.inc()
                     logger.exception("scoring sink failed")
+                else:
+                    self.stage_sink.observe(time.monotonic() - now)
         finally:
             self.inflight -= 1
             self.settled_count += 1
@@ -517,8 +562,7 @@ class ScoringSession:
     def _recover_ring(self) -> None:
         # the faulted ring's donated buffers are gone — allocate fresh
         # state FIRST, then repopulate it from the host store
-        self.ring = DeviceRing(self.model.cfg.window,
-                               capacity=self.ring.capacity)
+        self.ring = self._new_ring(self.ring.capacity)
         try:
             self._load_ring()
         except Exception:  # noqa: BLE001 - empty ring still scores (count=0)

@@ -212,18 +212,63 @@ class TcpEventReceiver(BackgroundTaskComponent):
 class MqttEventReceiver(BackgroundTaskComponent):
     """MQTT ingest endpoint (reference analog: MqttInboundEventReceiver).
     Hosts a minimal MQTT 3.1.1 server (services/mqtt.py) — any standard
-    device client can CONNECT and PUBLISH SWB1/JSON payloads at QoS 0/1.
-    The MQTT topic becomes the batch source."""
+    device client can CONNECT and PUBLISH SWB1/JSON payloads at QoS 0/1/2.
+    The MQTT topic becomes the batch source.
+
+    Security (receiver config):
+    - `users: {username: password}` — when present, CONNECT must carry
+      matching credentials or it is refused (CONNACK code 4).
+    - command-topic isolation (always on): a client may only subscribe
+    to its OWN command topic `<command_topic_prefix><client_id>`;
+    filters reaching into the command space any other way (wildcards
+    included) get SUBACK failure 0x80. Non-command topics stay open."""
 
     def __init__(self, name: str, engine: "EventSourcesEngine",
                  decoder: EventDecoder, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, users: Optional[dict] = None,
+                 command_topic_prefix: str = "swx/commands/",
+                 require_client_id_match: bool = False):
         super().__init__(name)
         self.engine = engine
         self.decoder = decoder
+        self.users = dict(users) if users else None
+        self.command_topic_prefix = command_topic_prefix
+        # per-device credentials mode: username must equal client_id, so
+        # the client_id the own-command-topic rule trusts is the one the
+        # password proved. Off by default for the gateway pattern (one
+        # credential publishing many devices' telemetry) — gateways that
+        # also subscribe to command topics should enable this.
+        self.require_client_id_match = require_client_id_match
         from sitewhere_tpu.services.mqtt import MqttListener
 
-        self.listener = MqttListener(self._on_publish, host=host, port=port)
+        self.listener = MqttListener(
+            self._on_publish, host=host, port=port,
+            authenticate=self._authenticate if self.users else None,
+            authorize_sub=self._authorize_sub)
+
+    def _authenticate(self, client_id: str, username, password) -> bool:
+        if username is None or self.users.get(username) != password:
+            return False
+        return not self.require_client_id_match or username == client_id
+
+    def _authorize_sub(self, client_id: str, topic_filter: str) -> bool:
+        prefix = self.command_topic_prefix
+        if topic_filter == f"{prefix}{client_id}":
+            return True  # a device's own command topic
+        # any filter that could match the command space is denied: a
+        # literal command prefix, or wildcards positioned to reach it
+        # (conservative: any multi-level wildcard, or a single-level
+        # wildcard inside the prefix path)
+        if topic_filter.startswith(prefix):
+            return False
+        parts = topic_filter.split("/")
+        pparts = prefix.rstrip("/").split("/")
+        for i, s in enumerate(parts):
+            if s == "#":
+                return False  # matches everything below, incl. commands
+            if i < len(pparts) and s != "+" and s != pparts[i]:
+                return True   # diverges from the command prefix: safe
+        return len(parts) <= len(pparts)  # shorter than prefix: safe
 
     @property
     def port(self) -> int:
@@ -287,9 +332,14 @@ class EventSourcesEngine(TenantEngine):
                                  host=cfg.get("host", "127.0.0.1"),
                                  port=cfg.get("port", 0))
         elif kind == "mqtt":
-            r = MqttEventReceiver(name, self, decoder,
-                                  host=cfg.get("host", "127.0.0.1"),
-                                  port=cfg.get("port", 0))
+            r = MqttEventReceiver(
+                name, self, decoder,
+                host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+                users=cfg.get("users"),
+                command_topic_prefix=cfg.get("command_topic_prefix",
+                                             "swx/commands/"),
+                require_client_id_match=cfg.get("require_client_id_match",
+                                                False))
         else:
             raise ValueError(f"unknown receiver kind {kind!r}")
         self.receivers.append(r)

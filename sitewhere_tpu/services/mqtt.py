@@ -29,10 +29,18 @@ logger = logging.getLogger(__name__)
 # MQTT 3.1.1 control packet types (spec §2.2.1)
 CONNECT, CONNACK = 1, 2
 PUBLISH, PUBACK = 3, 4
+PUBREC, PUBREL, PUBCOMP = 5, 6, 7
 SUBSCRIBE, SUBACK = 8, 9
 UNSUBSCRIBE, UNSUBACK = 10, 11
 PINGREQ, PINGRESP = 12, 13
 DISCONNECT = 14
+
+# CONNACK return codes (spec §3.2.2.3)
+CONNACK_ACCEPTED = 0
+CONNACK_BAD_PROTOCOL = 1
+CONNACK_ID_REJECTED = 2
+CONNACK_BAD_CREDENTIALS = 4
+CONNACK_NOT_AUTHORIZED = 5
 
 MAX_PACKET = 16 * 1024 * 1024
 
@@ -75,15 +83,32 @@ class MqttSession:
         self.writer = writer
         self.subscriptions: list[str] = []
         self.connected_at = time.time()
+        # QoS2 packet ids seen (PUBLISH processed, PUBREL not yet received):
+        # a retransmitted QoS2 PUBLISH must not be processed twice
+        self.qos2_pending: set[int] = set()
 
 
 class MqttListener:
     """The asyncio MQTT endpoint. `on_publish(topic, payload, client_id)`
-    is awaited for every inbound PUBLISH."""
+    is awaited for every inbound PUBLISH.
 
-    def __init__(self, on_publish, host: str = "127.0.0.1", port: int = 0):
+    Security hooks (both optional; None = open, for loopback/test use):
+    - `authenticate(client_id, username, password) -> bool`: checked at
+      CONNECT. When set, a client without credentials (or with wrong
+      ones) gets CONNACK return code 4 and the connection is closed —
+      nothing it sends is ever handed to `on_publish`.
+    - `authorize_sub(client_id, topic_filter) -> bool`: checked per
+      SUBSCRIBE filter. A denied filter gets SUBACK failure code 0x80
+      and is not registered — a device cannot subscribe to another
+      device's command topic (or `#`-wildcard its way to the whole
+      command space)."""
+
+    def __init__(self, on_publish, host: str = "127.0.0.1", port: int = 0,
+                 authenticate=None, authorize_sub=None):
         self.on_publish = on_publish
         self.host, self.port = host, port
+        self.authenticate = authenticate
+        self.authorize_sub = authorize_sub
         self.sessions: dict[str, MqttSession] = {}
         self._conns: set[asyncio.StreamWriter] = set()
         self._server: Optional[asyncio.AbstractServer] = None
@@ -156,10 +181,18 @@ class MqttListener:
                 body = await reader.readexactly(length) if length else b""
                 if ptype == CONNECT:
                     session = await self._on_connect(body, writer)
+                    if session is None:
+                        return  # rejected (bad credentials/protocol)
                 elif session is None:
                     return  # first packet must be CONNECT (spec §3.1)
                 elif ptype == PUBLISH:
                     await self._on_publish(flags, body, session, writer)
+                elif ptype == PUBREL:
+                    # QoS2 release: the sender may now forget the packet id
+                    packet_id = int.from_bytes(body[0:2], "big")
+                    session.qos2_pending.discard(packet_id)
+                    writer.write(_packet(PUBCOMP, 0,
+                                         packet_id.to_bytes(2, "big")))
                 elif ptype == SUBSCRIBE:
                     self._on_subscribe(body, session, writer)
                 elif ptype == UNSUBSCRIBE:
@@ -173,7 +206,10 @@ class MqttListener:
                     return
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError,
-                ValueError):
+                ValueError, IndexError):
+            # IndexError: truncated/malformed variable headers (hostile or
+            # buggy clients) must drop the connection, not escape the
+            # handler as a traceback
             pass
         finally:
             self._conns.discard(writer)
@@ -181,20 +217,48 @@ class MqttListener:
                 self.sessions.pop(session.client_id, None)
             writer.close()
 
-    async def _on_connect(self, body: bytes, writer) -> MqttSession:
+    async def _on_connect(self, body: bytes, writer) -> Optional[MqttSession]:
         proto, off = _utf8(body, 0)
         level = body[off]
         off += 1  # protocol level (4 for 3.1.1)
-        _connect_flags = body[off]
+        connect_flags = body[off]
         off += 1
         off += 2  # keepalive
         client_id, off = _utf8(body, off)
+        if connect_flags & 0x04:  # will flag: skip will topic + message
+            _will_topic, off = _utf8(body, off)
+            will_len = int.from_bytes(body[off:off + 2], "big")
+            off += 2 + will_len
+        username = password = None
+        if connect_flags & 0x80:
+            username, off = _utf8(body, off)
+        if connect_flags & 0x40:
+            pw_len = int.from_bytes(body[off:off + 2], "big")
+            password = body[off + 2:off + 2 + pw_len].decode("utf-8")
+            off += 2 + pw_len
         if not client_id:
             client_id = f"anon-{id(writer):x}"
+        if proto != "MQTT" or level != 4:
+            writer.write(_packet(CONNACK, 0, bytes([0, CONNACK_BAD_PROTOCOL])))
+            return None
+        # a client_id containing topic syntax ('#', '+', '/') could forge
+        # its way past prefix-based subscription authorization (e.g.
+        # client_id '#' makes 'swx/commands/#' look like "its own" topic)
+        if any(ch in client_id for ch in "#+/"):
+            logger.warning("mqtt: rejected CONNECT with hostile client id %r",
+                           client_id)
+            writer.write(_packet(CONNACK, 0, bytes([0, CONNACK_ID_REJECTED])))
+            return None
+        if self.authenticate is not None and not self.authenticate(
+                client_id, username, password):
+            logger.warning("mqtt: rejected CONNECT from %r (bad credentials)",
+                           client_id)
+            writer.write(_packet(CONNACK, 0,
+                                 bytes([0, CONNACK_BAD_CREDENTIALS])))
+            return None
         session = MqttSession(client_id, writer)
         self.sessions[client_id] = session
-        accepted = 0 if proto == "MQTT" and level == 4 else 1
-        writer.write(_packet(CONNACK, 0, bytes([0, accepted])))
+        writer.write(_packet(CONNACK, 0, bytes([0, CONNACK_ACCEPTED])))
         return session
 
     async def _on_publish(self, flags: int, body: bytes,
@@ -206,8 +270,16 @@ class MqttListener:
             packet_id = int.from_bytes(body[off:off + 2], "big")
             off += 2
         payload = body[off:]
+        if qos == 2 and packet_id is not None:
+            # QoS2 method B: process on first sight, dedup retransmits,
+            # PUBREC now — PUBREL→PUBCOMP completes in the handler loop
+            if packet_id not in session.qos2_pending:
+                session.qos2_pending.add(packet_id)
+                await self.on_publish(topic, payload, session.client_id)
+            writer.write(_packet(PUBREC, 0, packet_id.to_bytes(2, "big")))
+            return
         await self.on_publish(topic, payload, session.client_id)
-        if qos >= 1 and packet_id is not None:  # QoS2 downgraded to 1
+        if qos == 1 and packet_id is not None:
             writer.write(_packet(PUBACK, 0, packet_id.to_bytes(2, "big")))
 
     def _on_subscribe(self, body: bytes, session: MqttSession,
@@ -218,6 +290,13 @@ class MqttListener:
         while off < len(body):
             topic_filter, off = _utf8(body, off)
             off += 1  # requested QoS; we grant QoS0
+            if (self.authorize_sub is not None
+                    and not self.authorize_sub(session.client_id,
+                                               topic_filter)):
+                logger.warning("mqtt: denied SUBSCRIBE %r from %r",
+                               topic_filter, session.client_id)
+                codes.append(0x80)  # failure return code (spec §3.9.3)
+                continue
             session.subscriptions.append(topic_filter)
             codes.append(0)
         writer.write(_packet(SUBACK, 0, packet_id.to_bytes(2, "big")

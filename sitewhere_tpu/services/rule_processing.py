@@ -106,6 +106,7 @@ class RuleProcessingEngine(TenantEngine):
             buckets=tuple(cfg.get("buckets",
                                   self.runtime.settings.scoring_batch_buckets)),
             capacity=cfg.get("capacity", 0),
+            max_inflight=cfg.get("max_inflight", 64),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.shared: bool = cfg.get("shared", False)
@@ -226,6 +227,17 @@ class RuleProcessor(BackgroundTaskComponent):
         ckpt: Optional[tuple[int, dict]] = None
         try:
             while True:
+                if sink is not None and sink.backlogged:
+                    # backpressure: the scorer's admission backlog is at
+                    # capacity (warmup compile, regrow, overload). Stop
+                    # consuming — records stay in the bus uncommitted
+                    # (at-least-once preserved) instead of being dropped
+                    # after consume. Keep flushing so the backlog drains.
+                    if session is not None and session.flush_due:
+                        session.flush_nowait()
+                    await asyncio.sleep(
+                        max(sink.flush_wait_s, 0.001) if sink.ready else 0.05)
+                    continue
                 timeout = sink.flush_wait_s if sink else 0.2
                 records = await consumer.poll(max_records=64,
                                               timeout=max(timeout, 0.001))

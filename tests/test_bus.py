@@ -117,3 +117,51 @@ def test_topic_naming_convention():
         "swx1.tenant.acme.event-source-decoded-events"
     assert naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES) == \
         "swx1.instance.tenant-model-updates"
+
+
+def test_poll_wakes_on_any_assigned_partition(run):
+    """A consumer owning several partitions must wake promptly when a
+    record lands on ANY of them — not just the first (regression: the old
+    single-condition wait degraded to a 50 ms re-check loop, which landed
+    as wake-up jitter in the paced-p99 benchmark)."""
+
+    async def main():
+        bus = EventBus(default_partitions=4)
+        c = bus.subscribe("t", group="g")
+        assert len(c.assignment) == 4
+
+        async def produce_later():
+            await asyncio.sleep(0.05)
+            # explicit highest partition: the old code only waited on [0]
+            await bus.produce("t", "late", partition=3)
+
+        task = asyncio.get_running_loop().create_task(produce_later())
+        t0 = asyncio.get_running_loop().time()
+        records = await c.poll(timeout=5.0)
+        waited = asyncio.get_running_loop().time() - t0
+        await task
+        assert [r.value for r in records] == ["late"]
+        assert waited < 0.3  # woke on produce, not on poll timeout
+        c.close()
+
+    run(main())
+
+
+def test_close_wakes_blocked_poll(run):
+    async def main():
+        bus = EventBus(default_partitions=2)
+        c = bus.subscribe("t", group="g")
+
+        async def close_later():
+            await asyncio.sleep(0.05)
+            c.close()
+
+        task = asyncio.get_running_loop().create_task(close_later())
+        t0 = asyncio.get_running_loop().time()
+        records = await c.poll(timeout=5.0)
+        waited = asyncio.get_running_loop().time() - t0
+        await task
+        assert records == []
+        assert waited < 0.3
+
+    run(main())

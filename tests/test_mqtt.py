@@ -154,3 +154,149 @@ def test_mqtt_rejects_garbage_and_survives(run):
             w2.close()
 
     run(main())
+
+
+def connect_pkt_auth(client_id: str, username: str, password: str) -> bytes:
+    flags = 0x02 | 0x80 | 0x40  # clean session + username + password
+    body = _utf8("MQTT") + bytes([4, flags]) + (60).to_bytes(2, "big") \
+        + _utf8(client_id) + _utf8(username) + _utf8(password)
+    return _pkt(1, 0, body)
+
+
+def test_mqtt_connect_requires_credentials_when_configured(run):
+    """ADVICE regression: with `users` configured, an unauthenticated
+    CONNECT is refused (code 4) and its PUBLISHes never reach the
+    pipeline; correct credentials are accepted."""
+
+    async def main():
+        sections = {"event-sources": {"receivers": [
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt",
+             "users": {"gateway": "s3cret"}}]},
+            "rule-processing": {"model": None}}
+        async with running_pipeline(num_devices=5, sections=sections) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            # no credentials → refused
+            r1, w1 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w1.write(connect_pkt("dev-1"))
+            await w1.drain()
+            ptype, _, body = await read_pkt(r1)
+            assert ptype == 2 and body[1] == 4  # bad user or password
+            # wrong password → refused
+            r2, w2 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w2.write(connect_pkt_auth("dev-1", "gateway", "wrong"))
+            await w2.drain()
+            ptype, _, body = await read_pkt(r2)
+            assert ptype == 2 and body[1] == 4
+            # right credentials → accepted, telemetry flows
+            r3, w3 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w3.write(connect_pkt_auth("dev-1", "gateway", "s3cret"))
+            await w3.drain()
+            ptype, _, body = await read_pkt(r3)
+            assert ptype == 2 and body[1] == 0
+            sim = DeviceSimulator(SimConfig(num_devices=5), tenant_id="acme")
+            payload, _ = sim.payload(t=0.0)
+            w3.write(publish_pkt("swx/telemetry", payload, qos=1, packet_id=3))
+            await w3.drain()
+            ptype, _, _ = await read_pkt(r3)
+            assert ptype == 4  # PUBACK
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 5)
+            for w in (w1, w2, w3):
+                w.close()
+
+    run(main())
+
+
+def test_mqtt_command_topic_isolation(run):
+    """ADVICE regression: a client may subscribe only to ITS OWN command
+    topic; other devices' command topics and wildcard reaches into the
+    command space get SUBACK failure 0x80."""
+
+    async def main():
+        sections = {"event-sources": {"receivers": [
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"}]},
+            "rule-processing": {"model": None}}
+        async with running_pipeline(num_devices=5, sections=sections) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            r, w = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w.write(connect_pkt("dev-1"))
+            await w.drain()
+            await read_pkt(r)
+            cases = [("swx/commands/dev-1", 0x00),   # own topic: granted
+                     ("swx/commands/dev-2", 0x80),   # someone else's: denied
+                     ("swx/commands/#", 0x80),       # whole command space
+                     ("#", 0x80),                    # global wildcard
+                     ("swx/+/dev-2", 0x80),          # wildcard into commands
+                     ("swx/telemetry/x", 0x00)]      # unrelated: open
+            for i, (topic, expect) in enumerate(cases):
+                w.write(subscribe_pkt(topic, packet_id=20 + i))
+                await w.drain()
+                ptype, _, body = await read_pkt(r)
+                assert ptype == 9 and body[2] == expect, (topic, body[2])
+            w.close()
+
+    run(main())
+
+
+def test_mqtt_qos2_handshake_and_dedup(run):
+    """ADVICE regression: QoS2 PUBLISH gets PUBREC (not a PUBACK mis-ack),
+    PUBREL gets PUBCOMP, and a retransmitted QoS2 PUBLISH before PUBREL
+    is processed exactly once."""
+
+    async def main():
+        sections = {"event-sources": {"receivers": [
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"}]},
+            "rule-processing": {"model": None}}
+        async with running_pipeline(num_devices=5, sections=sections) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            r, w = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w.write(connect_pkt("dev-q2"))
+            await w.drain()
+            await read_pkt(r)
+            sim = DeviceSimulator(SimConfig(num_devices=5), tenant_id="acme")
+            payload, _ = sim.payload(t=0.0)
+            # PUBLISH qos2 → PUBREC
+            w.write(publish_pkt("swx/telemetry", payload, qos=2, packet_id=9))
+            await w.drain()
+            ptype, _, body = await read_pkt(r)
+            assert ptype == 5 and body == (9).to_bytes(2, "big")  # PUBREC
+            # retransmit (DUP) before PUBREL → PUBREC again, NOT re-ingested
+            w.write(publish_pkt("swx/telemetry", payload, qos=2, packet_id=9))
+            await w.drain()
+            ptype, _, _ = await read_pkt(r)
+            assert ptype == 5
+            # PUBREL → PUBCOMP
+            w.write(_pkt(6, 2, (9).to_bytes(2, "big")))
+            await w.drain()
+            ptype, _, body = await read_pkt(r)
+            assert ptype == 7 and body == (9).to_bytes(2, "big")  # PUBCOMP
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 5)
+            await asyncio.sleep(0.1)  # would catch a double-ingest
+            assert em.telemetry.total_events == 5
+            w.close()
+
+    run(main())
+
+
+def test_mqtt_rejects_wildcard_client_id(run):
+    """Code-review regression: a client_id containing topic syntax could
+    forge the own-command-topic authorization (client_id '#' makes
+    'swx/commands/#' look like its own topic) — rejected at CONNECT."""
+
+    async def main():
+        sections = {"event-sources": {"receivers": [
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"}]},
+            "rule-processing": {"model": None}}
+        async with running_pipeline(num_devices=5, sections=sections) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            for bad in ("#", "dev/+", "a/b"):
+                r, w = await asyncio.open_connection("127.0.0.1",
+                                                     receiver.port)
+                w.write(connect_pkt(bad))
+                await w.drain()
+                ptype, _, body = await read_pkt(r)
+                assert ptype == 2 and body[1] == 2, bad  # identifier rejected
+                w.close()
+
+    run(main())

@@ -283,3 +283,42 @@ def test_ring_matches_host_store_windows(run):
         assert (got_v == want_v).all()
 
     run(main())
+
+
+def test_admission_backpressure_never_drops(run):
+    """ADVICE regression: an at-capacity admission backlog (e.g. during a
+    warmup compile) must NOT drop already-consumed events — the session
+    reports `backlogged` and the consumer stops polling instead."""
+
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=100, seed=1), tenant_id="t")
+        _fill_store(store, sim, 40)
+        session = ScoringSession(
+            build_model("zscore", window=32), store, MetricsRegistry(),
+            ScoringConfig(buckets=(128,), threshold=4.0))
+        session.ready = False  # simulate a long warmup/regrow
+        total = 0
+        for k in range(30):  # 30 * 100 = 3000 > 16 * 128 = 2048 cap
+            batch, _ = sim.tick(t=(40 + k) * 60.0)
+            session.admit(batch)
+            total += len(batch)
+        assert session.pending_n == total  # nothing dropped
+        assert session.backlogged
+        # once ready, the backlog drains completely
+        session.warmup()
+        scored: list = []
+
+        async def sink(b):
+            scored.append(len(b))
+
+        session.sink = sink
+        while session.pending_n:
+            session.flush_nowait()
+            await asyncio.sleep(0.01)
+        await session.drain()
+        assert sum(scored) == total
+        assert not session.backlogged
+        session.close()
+
+    run(main())
