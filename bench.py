@@ -47,12 +47,20 @@ PEAK_BF16_FLOPS = (
 )
 
 
-def probe_backend(retries: int = 4, base_delay: float = 2.0):
+def probe_backend(retries: int = 4, base_delay: float = 2.0,
+                  attempt_timeout: float = 120.0):
     """Fail fast (and retryably) on a broken accelerator backend BEFORE
     building the whole runtime: list devices and run one tiny computation
-    end to end. Returns (platform, device_kind, n_chips)."""
-    last = None
-    for attempt in range(retries):
+    end to end. Returns (platform, device_kind, n_chips).
+
+    Each attempt runs in a daemon thread with a hard timeout — a hung
+    tunnel blocks `jax.devices()` indefinitely (observed), and a bench
+    that blocks forever leaves the round with no artifact at all."""
+    import threading
+
+    last: list = [None]
+
+    def attempt_once(result: list):
         try:
             import jax
             import jax.numpy as jnp
@@ -60,13 +68,73 @@ def probe_backend(retries: int = 4, base_delay: float = 2.0):
             devs = jax.devices()
             x = jnp.ones((8, 8))
             (x @ x).block_until_ready()
-            return devs[0].platform, devs[0].device_kind, len(devs)
+            result.append((devs[0].platform, devs[0].device_kind, len(devs)))
         except Exception as exc:  # noqa: BLE001 - probe failure is data
-            last = exc
-            if attempt < retries - 1:
-                time.sleep(base_delay * (2 ** attempt))
+            last[0] = exc
+
+    for attempt in range(retries):
+        result: list = []
+        t = threading.Thread(target=attempt_once, args=(result,), daemon=True)
+        t.start()
+        t.join(attempt_timeout)
+        if result:
+            return result[-1]
+        if t.is_alive():
+            # the backend call is stuck in native code; we cannot kill it,
+            # only abandon it — and a retry would join the same stuck
+            # global backend init, so fail the run with a clear artifact
+            raise RuntimeError(
+                f"accelerator backend probe hung for {attempt_timeout}s "
+                "(tunnel down?)")
+        if attempt < retries - 1:
+            time.sleep(base_delay * (2 ** attempt))
     raise RuntimeError(f"accelerator backend probe failed after "
-                       f"{retries} attempts: {last!r}") from last
+                       f"{retries} attempts: {last[0]!r}") from last[0]
+
+
+def run_train_bench(args) -> dict:
+    """Training-plane bench: ETL (windows/s) + train step rate (step/s,
+    windows trained/s) for the selected model on the live backend."""
+    import numpy as np
+
+    from sitewhere_tpu.models import build_model
+    from sitewhere_tpu.training.trainer import (
+        Trainer,
+        TrainerConfig,
+        make_windows,
+    )
+
+    platform, device_kind, n_chips = probe_backend()
+    model = build_model(
+        "lstm" if args.model == "lstm-stream" else args.model,
+        window=args.window)
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal(
+        (args.devices, args.history)).astype(np.float32)
+    counts = np.full(args.devices, args.history)
+    t0 = time.monotonic()
+    windows, valid = make_windows(values, counts, window=args.window,
+                                  max_windows=1_000_000)
+    etl_s = time.monotonic() - t0
+    trainer = Trainer(model, TrainerConfig(batch_size=2048, steps=20,
+                                           log_every=20))
+    _, warm = trainer.train(windows[:4096], valid[:4096])  # compile
+    t0 = time.monotonic()
+    params, report = trainer.train(windows, valid)
+    train_s = time.monotonic() - t0
+    steps = report["steps"]
+    return {
+        "metric": "train_windows_per_sec",
+        "value": round(steps * 2048 / train_s, 1),
+        "unit": "windows/s",
+        "vs_baseline": 0.0,  # no reference training plane exists
+        "etl_windows_per_sec": round(windows.shape[0] / etl_s, 1),
+        "etl_seconds": round(etl_s, 3),
+        "steps_per_sec": round(steps / train_s, 2),
+        "final_loss": report["final_loss"],
+        "model": args.model, "platform": platform,
+        "device_kind": device_kind, "chips": n_chips,
+    }
 
 
 async def run_bench(args) -> dict:
@@ -105,56 +173,70 @@ async def run_bench(args) -> dict:
                 DeviceStateService, RuleProcessingService):
         rt.add_service(cls(rt))
     await rt.start()
-    await rt.add_tenant(TenantConfig(tenant_id="bench", sections={
-        "event-management": {"history": args.history},
-        "rule-processing": {
-            "model": args.model,
-            "model_config": {"window": args.window},
-            "threshold": 6.0,
-            "batch_window_ms": args.window_ms,
-            "buckets": [args.devices],  # fleet-sized bucket: 1 flush = 1 XLA call
-            "capacity": args.devices,   # pre-size the device ring: no regrow
-            "max_inflight": args.max_inflight,
-        },
-    }))
-    dm = rt.api("device-management").management("bench")
-    dm.bootstrap_fleet(DeviceType(token="thermo", name="Thermometer"),
-                       args.devices)
-
-    em = rt.api("event-management").management("bench")
-    sim = DeviceSimulator(SimConfig(num_devices=args.devices,
-                                    anomaly_rate=0.001,
-                                    anomaly_magnitude=12.0),
-                          tenant_id="bench")
-
-    # warm history directly into the store (not measured)
-    for k in range(args.window + 4):
-        batch, _ = sim.tick(t=60.0 * k)
-        em.telemetry.append_measurements(batch)
-
-    receiver = rt.api("event-sources").engine("bench").receiver("default")
-    session = rt.api("rule-processing").engine("bench").session
+    # --pooled T = config 4: T tenants sharing one stacked-params scorer
+    # (one vmapped XLA call per flush scores every tenant); otherwise one
+    # tenant with a dedicated session
+    pooled = args.pooled > 1
+    tenant_ids = ([f"bench{i}" for i in range(args.pooled)] if pooled
+                  else ["bench"])
+    per_tenant = max(args.devices // len(tenant_ids), 1)
+    for tid in tenant_ids:
+        await rt.add_tenant(TenantConfig(tenant_id=tid, sections={
+            "event-management": {"history": args.history},
+            "rule-processing": {
+                "model": args.model,
+                "model_config": {"window": args.window},
+                "threshold": 6.0,
+                "batch_window_ms": args.window_ms,
+                "buckets": [per_tenant],  # fleet bucket: 1 flush = 1 XLA call
+                "capacity": per_tenant,   # pre-size the ring: no regrow
+                "max_inflight": args.max_inflight,
+                "shared": pooled,
+            },
+        }))
+    sims, receivers, sinks = [], [], []
+    t_base = 60.0 * (args.window + 4)
+    for tid in tenant_ids:
+        dm = rt.api("device-management").management(tid)
+        dm.bootstrap_fleet(DeviceType(token="thermo", name="Thermometer"),
+                           per_tenant)
+        em = rt.api("event-management").management(tid)
+        sim = DeviceSimulator(SimConfig(num_devices=per_tenant,
+                                        anomaly_rate=0.001,
+                                        anomaly_magnitude=12.0),
+                              tenant_id=tid)
+        # warm history directly into the store (not measured)
+        for k in range(args.window + 4):
+            batch, _ = sim.tick(t=60.0 * k)
+            em.telemetry.append_measurements(batch)
+        sims.append(sim)
+        receivers.append(rt.api("event-sources").engine(tid)
+                         .receiver("default"))
+        eng = rt.api("rule-processing").engine(tid)
+        sinks.append(eng.session or eng.pool_slot)
     # wait for background warmup (bucket compiles) before measuring
     t_warm = time.monotonic()
-    while not session.ready:
+    while not all(s.ready for s in sinks):
         await asyncio.sleep(0.1)
         if time.monotonic() - t_warm > args.ready_timeout:
             raise TimeoutError(
                 f"scoring warmup did not finish in {args.ready_timeout}s")
     # the warm history above entered the store directly (not via the
-    # pipeline), so sync the device-resident ring from it
-    session.reload_history()
+    # pipeline), so sync the device-resident rings from it
+    for s in sinks:
+        s.reload_history()
+    session = sinks[0]
 
     # warmup pass through the whole pipeline (jit already compiled in
     # engine start; this warms caches end to end)
-    t_base = 60.0 * (args.window + 4)
     for k in range(3):
-        await receiver.submit(sim.payload(t=t_base + k)[0])
+        for sim, receiver in zip(sims, receivers):
+            await receiver.submit(sim.payload(t=t_base + k)[0])
     await asyncio.sleep(0.5)
 
     # measured run: feed as fast as the pipeline absorbs (bounded queue
     # provides backpressure); latency stats reset for the measured window
-    lat_hist = session.latency
+    lat_hist = session.latency  # pooled: one shared histogram
     lat_hist.reset()
 
     # ---- phase 1: saturation throughput (open loop + drain) ----
@@ -164,18 +246,23 @@ async def run_bench(args) -> dict:
     k = 0
     sent = 0
     while time.monotonic() - t0 < args.seconds:
-        payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
-        await receiver.submit(payload)
-        sent += args.devices
+        for sim, receiver in zip(sims, receivers):
+            payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
+            await receiver.submit(payload)
+            sent += per_tenant
         k += 1
     # drain: wait until every sent event is scored and settled
     t_drain = time.monotonic()
     deadline = t_drain + args.drain_timeout
-    while ((lat_hist.count < sent or session.inflight > 0)
+
+    def inflight_total():
+        return sum(s.inflight for s in sinks)
+
+    while ((lat_hist.count < sent or inflight_total() > 0)
            and time.monotonic() < deadline):
         await asyncio.sleep(0.05)
     sat_drain_s = time.monotonic() - t_drain
-    sat_drain_ok = lat_hist.count >= sent and session.inflight == 0
+    sat_drain_ok = lat_hist.count >= sent and inflight_total() == 0
     elapsed = time.monotonic() - t0
     if args.profile:
         jax.profiler.stop_trace()
@@ -186,29 +273,33 @@ async def run_bench(args) -> dict:
     # p99 under flood measures queue depth, not the system; pace at a
     # fraction of measured capacity and report honest tail latency
     paced_rate = args.paced_fraction * rate
-    interval = args.devices / max(paced_rate, 1.0)
+    interval = len(tenant_ids) * per_tenant / max(paced_rate, 1.0)
     lat_hist.reset()
-    for h in (session.stage_admit, session.stage_batch,
-              session.stage_device, session.stage_sink):
-        h.reset()  # breakdown describes the paced window only
+    stage_hists = tuple(
+        getattr(session, f"stage_{nm}", None)
+        for nm in ("admit", "batch", "device", "sink"))
+    for h in stage_hists:
+        if h is not None:
+            h.reset()  # breakdown describes the paced window only
     t1 = time.monotonic()
     paced_sent = 0
     next_t = t1
     while time.monotonic() - t1 < args.latency_seconds:
-        payload, _ = sim.payload(t=t_base + 10_000 + 0.001 * paced_sent)
-        await receiver.submit(payload)
-        paced_sent += args.devices
+        for sim, receiver in zip(sims, receivers):
+            payload, _ = sim.payload(t=t_base + 10_000 + 0.001 * paced_sent)
+            await receiver.submit(payload)
+            paced_sent += per_tenant
         next_t += interval
         delay = next_t - time.monotonic()
         if delay > 0:
             await asyncio.sleep(delay)
     t_drain = time.monotonic()
     deadline = t_drain + args.latency_drain_timeout
-    while ((lat_hist.count < paced_sent or session.inflight > 0)
+    while ((lat_hist.count < paced_sent or inflight_total() > 0)
            and time.monotonic() < deadline):
         await asyncio.sleep(0.05)
     lat_drain_s = time.monotonic() - t_drain
-    lat_drain_ok = lat_hist.count >= paced_sent and session.inflight == 0
+    lat_drain_ok = lat_hist.count >= paced_sent and inflight_total() == 0
 
     if args.debug_stages:
         import pprint
@@ -222,15 +313,21 @@ async def run_bench(args) -> dict:
     p99 = lat_hist.quantile(0.99)
     p50 = lat_hist.quantile(0.50)
     breakdown = {}
-    for nm, h in (("admit", session.stage_admit),
-                  ("batch", session.stage_batch),
-                  ("device", session.stage_device),
-                  ("sink", session.stage_sink)):
-        breakdown[nm] = {"p50_ms": round(h.quantile(0.5) * 1e3, 3),
-                         "p99_ms": round(h.quantile(0.99) * 1e3, 3)}
+    for nm, h in zip(("admit", "batch", "device", "sink"), stage_hists):
+        if h is not None:
+            breakdown[nm] = {"p50_ms": round(h.quantile(0.5) * 1e3, 3),
+                             "p99_ms": round(h.quantile(0.99) * 1e3, 3)}
 
     # MFU: achieved model FLOP/s at the saturation rate vs chip peak
-    flops_ev = float(getattr(session.model, "flops_per_event",
+    model_obj = getattr(session, "model", None) or session.pool.model
+    if pooled and getattr(model_obj, "streaming", False):
+        # the shared pool has no streaming stacked ring (yet): it executes
+        # the windowed W-step rescan — account FLOPs for the path that
+        # actually ran, not the streaming estimate (~63x lower)
+        from sitewhere_tpu.models.lstm import LstmAnomalyModel
+
+        model_obj = LstmAnomalyModel(model_obj.cfg)
+    flops_ev = float(getattr(model_obj, "flops_per_event",
                              lambda: 0.0)())
     model_flops_s = rate * flops_ev
     kind_l = device_kind.lower()
@@ -251,6 +348,7 @@ async def run_bench(args) -> dict:
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),
         "model": args.model,
+        "tenants": len(tenant_ids),
         "model_flops_per_event": flops_ev,
         "model_tflops": round(model_flops_s / 1e12, 3),
         "mfu": round(mfu, 5) if mfu is not None else None,
@@ -281,6 +379,9 @@ def main() -> None:
                              "measured saturation rate; 0.5 keeps queues "
                              "near-empty so the p99 is the system's, not "
                              "the backlog's")
+    parser.add_argument("--pooled", type=int, default=1, metavar="T",
+                        help="config-4 mode: T tenants share one stacked "
+                             "scoring pool (one vmapped call per flush)")
     parser.add_argument("--max-inflight", type=int, default=8,
                         help="dispatched-not-settled flush bound; small "
                              "values cap XLA queue depth (tail latency), "
@@ -296,14 +397,21 @@ def main() -> None:
                         help="write a jax.profiler trace of phase 1 to DIR")
     parser.add_argument("--debug-stages", action="store_true",
                         help="dump sampled per-stage span stats to stderr")
+    parser.add_argument("--train", action="store_true",
+                        help="bench the training plane (ETL windows/s + "
+                             "train step/s) instead of the scoring pipeline")
     args = parser.parse_args()
     try:
-        result = asyncio.run(run_bench(args))
+        result = (run_train_bench(args) if args.train
+                  else asyncio.run(run_bench(args)))
     except BaseException as exc:  # noqa: BLE001 - the artifact must parse
         traceback.print_exc()
         print(json.dumps({
-            "metric": "pipeline_scored_events_per_sec",
-            "value": 0.0, "unit": "events/s", "vs_baseline": 0.0,
+            "metric": ("train_windows_per_sec" if args.train
+                       else "pipeline_scored_events_per_sec"),
+            "value": 0.0,
+            "unit": "windows/s" if args.train else "events/s",
+            "vs_baseline": 0.0,
             "error": f"{type(exc).__name__}: {exc}",
             "model": args.model, "fleet_devices": args.devices,
         }))

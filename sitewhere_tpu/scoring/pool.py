@@ -142,6 +142,13 @@ class TenantSlot:
     def swap_params(self, params: dict) -> int:
         return self.pool.stack.set_params(self.tenant_id, params)
 
+    def reload_history(self) -> None:
+        """Re-seed this tenant's ring slice from its host store (bulk
+        imports that bypassed admit) — mirrors ScoringSession's."""
+        entry = self.pool.tenants[self.tenant_id]
+        self.pool._seed_tenant_ring(self.pool.stack.slots[self.tenant_id],
+                                    entry.telemetry)
+
 
 class SharedScoringPool:
     """One stack + one ring + one flusher for every tenant of one model
