@@ -23,8 +23,7 @@ import sys
 import time
 
 
-def _build_runtime(settings, tenants):
-    from sitewhere_tpu.kernel.service import ServiceRuntime
+def _service_classes():
     from sitewhere_tpu.services import (
         AssetManagementService,
         BatchOperationsService,
@@ -42,16 +41,118 @@ def _build_runtime(settings, tenants):
         ScheduleManagementService,
     )
 
-    rt = ServiceRuntime(settings)
-    for cls in (InstanceManagementService, DeviceManagementService,
-                AssetManagementService, EventSourcesService,
-                InboundProcessingService, EventManagementService,
-                DeviceStateService, RuleProcessingService,
-                DeviceRegistrationService, CommandDeliveryService,
-                OutboundConnectorsService, BatchOperationsService,
-                ScheduleManagementService, LabelGenerationService):
-        rt.add_service(cls(rt))
+    # start order: identity/config first, then the pipeline, then aux
+    ordered = (InstanceManagementService, DeviceManagementService,
+               AssetManagementService, EventSourcesService,
+               InboundProcessingService, EventManagementService,
+               DeviceStateService, RuleProcessingService,
+               DeviceRegistrationService, CommandDeliveryService,
+               OutboundConnectorsService, BatchOperationsService,
+               ScheduleManagementService, LabelGenerationService)
+    return {cls.identifier: cls for cls in ordered}
+
+
+# cross-service dependencies that MUST be satisfied by a LOCAL peer —
+# these call sites use the peer synchronously/deeply (e.g.
+# event-management builds its SPI around the dm engine object), so a
+# wire proxy cannot stand in. A split that violates this fails loudly
+# at startup instead of misbehaving at runtime.
+_COLOCATE = {
+    "event-management": {"device-management"},
+    "device-registration": {"device-management"},
+    "command-delivery": {"device-management", "event-management"},
+    "batch-operations": {"device-management", "event-management"},
+    "schedule-management": {"device-management", "event-management",
+                            "batch-operations"},
+    "label-generation": {"device-management", "asset-management"},
+    "rule-processing": {"event-management", "device-state"},
+}
+# services whose consumers guard for awaitable (wire-proxy) results —
+# the only identifiers --remote currently supports
+_WIRE_AWARE_REMOTES = {"device-management"}
+# ...and which local services can actually use that remote peer
+_REMOTE_CONSUMERS = {"device-management": {"inbound-processing"}}
+
+
+def _validate_split(services, remotes):
+    if services is None:
+        return
+    for name in services:
+        need = _COLOCATE.get(name, set())
+        missing = need - services
+        if missing:
+            raise SystemExit(
+                f"swx run: service {name!r} must be colocated with "
+                f"{sorted(missing)} (deep in-process integration); host "
+                f"them in this process or drop {name!r} from --services")
+    for identifier in remotes or ():
+        if identifier in services:
+            raise SystemExit(
+                f"swx run: {identifier!r} is both local (--services) and "
+                f"remote (--remote)")
+        if identifier not in _WIRE_AWARE_REMOTES:
+            raise SystemExit(
+                f"swx run: --remote {identifier} is not supported yet — "
+                f"only {sorted(_WIRE_AWARE_REMOTES)} have wire-aware "
+                f"consumers")
+        consumers = _REMOTE_CONSUMERS.get(identifier, set())
+        if not consumers & services:
+            raise SystemExit(
+                f"swx run: --remote {identifier} is unused — none of "
+                f"{sorted(services)} consume it over the wire")
+
+
+def _build_runtime(settings, tenants, services=None, bus=None, remotes=None):
+    """Assemble a runtime. `services` (names) selects a subset for
+    process-split deployment; `bus` may be a RemoteEventBus; `remotes`
+    maps identifier -> (host, port) of peers hosting other services."""
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+
+    classes = _service_classes()
+    if services is not None:
+        unknown = services - set(classes)
+        if unknown:
+            raise SystemExit(f"swx run: unknown services {sorted(unknown)} "
+                             f"(known: {sorted(classes)})")
+    _validate_split(services, remotes)
+    rt = ServiceRuntime(settings, bus=bus)
+    for name, cls in classes.items():
+        if services is None or name in services:
+            rt.add_service(cls(rt))
+    for identifier, (host, port) in (remotes or {}).items():
+        rt.add_remote_service(identifier, host, port)
     return rt
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def cmd_serve_bus(args) -> int:
+    """Run the broker process: an EventBus served over the wire
+    (kernel/wire.py). Peer `swx run --bus` processes attach to it."""
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.wire import BusServer
+
+    bus = EventBus(default_partitions=args.partitions,
+                   retention=args.retention)
+    await bus.initialize()
+    await bus.start()
+    server = BusServer(bus, host=args.host, port=args.port)
+    await server.start()
+    print(f"swx bus broker on {server.host}:{server.port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover
+            pass
+    await stop.wait()
+    await server.stop()
+    await bus.stop()
+    return 0
 
 
 async def cmd_run(args) -> int:
@@ -72,17 +173,45 @@ async def cmd_run(args) -> int:
 
         settings = dataclasses.replace(settings, rest_port=args.port)
 
-    rt = _build_runtime(settings, tenants)
+    # process-split deployment: subset of services + shared wire bus +
+    # remote peers (reference: 14 cooperating processes over Kafka+gRPC)
+    bus = None
+    if args.bus:
+        from sitewhere_tpu.kernel.wire import RemoteEventBus
+
+        bus = RemoteEventBus(*_parse_addr(args.bus))
+    services = set(args.services.split(",")) if args.services else None
+    remotes = {}
+    for spec in args.remote or ():
+        identifier, _, addr = spec.partition("=")
+        remotes[identifier] = _parse_addr(addr)
+
+    rt = _build_runtime(settings, tenants, services=services, bus=bus,
+                        remotes=remotes)
     await rt.start()
+    api_server = None
+    if args.api_port is not None:
+        from sitewhere_tpu.kernel.wire import ApiServer
+
+        api_server = ApiServer(rt, host="127.0.0.1", port=args.api_port)
+        await api_server.start()
+        print(f"swx api server on 127.0.0.1:{api_server.port}", flush=True)
+    if args.no_tenants:
+        tenants = []
     for tenant in tenants:
-        im = rt.services["instance-management"]
-        await im.create_tenant(tenant.tenant_id, tenant.name,
-                               dict(tenant.sections),
-                               tuple(tenant.authorized_user_ids))
-    rest = rt.services["instance-management"].rest
+        if "instance-management" in rt.services:
+            im = rt.services["instance-management"]
+            await im.create_tenant(tenant.tenant_id, tenant.name,
+                                   dict(tenant.sections),
+                                   tuple(tenant.authorized_user_ids))
+        else:
+            await rt.add_tenant(tenant)
+    im_svc = rt.services.get("instance-management")
+    rest = im_svc.rest if im_svc is not None else None
     print(f"swx instance {settings.instance_id} up; "
-          f"REST on {rest.host}:{rest.port}" if rest else "REST disabled",
-          flush=True)
+          f"REST on {rest.host}:{rest.port}" if rest else
+          f"swx instance {settings.instance_id} up (no REST in this "
+          f"process)", flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -92,6 +221,8 @@ async def cmd_run(args) -> int:
         except NotImplementedError:  # pragma: no cover
             pass
     await stop.wait()
+    if api_server is not None:
+        await api_server.stop()
     await rt.stop()
     return 0
 
@@ -170,15 +301,91 @@ async def cmd_demo(args) -> int:
     return 0
 
 
+async def cmd_train(args) -> int:
+    """Train a model over synthetic or store-snapshot windows; with
+    --distributed, join the multi-host process group (SWX_COORDINATOR /
+    SWX_NUM_PROCESSES / SWX_PROCESS_ID or explicit flags) and train over
+    the GLOBAL mesh — the v5p-32 nightly-retrain entry [SURVEY §2.4]."""
+    import numpy as np
+
+    from sitewhere_tpu.models import build_model
+    from sitewhere_tpu.parallel.distributed import (
+        initialize_distributed,
+        make_global_mesh,
+        process_info,
+    )
+    from sitewhere_tpu.parallel.mesh import make_mesh
+    from sitewhere_tpu.training.checkpoint import CheckpointStore
+    from sitewhere_tpu.training.trainer import Trainer, TrainerConfig, make_windows
+
+    if args.distributed:
+        joined = initialize_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+        if not joined:
+            print("train: --distributed set but no coordinator "
+                  "(flag or SWX_COORDINATOR)", file=sys.stderr)
+            return 2
+        mesh = make_global_mesh(model=1)
+        info = process_info()
+        print(f"train: rank {info['process_index']}/{info['process_count']}"
+              f" global_devices={info['global_devices']}")
+    else:
+        mesh = make_mesh(model=1)
+
+    model = build_model(args.model if args.model != "lstm-stream" else "lstm",
+                        window=args.window)
+    rng = np.random.default_rng(args.seed)  # identical data on every rank
+    values = rng.normal(20.0, 2.0,
+                        (args.devices, args.history)).astype(np.float32)
+    windows, valid = make_windows(values, np.full(args.devices, args.history),
+                                  window=args.window, max_windows=500_000)
+    trainer = Trainer(model, TrainerConfig(batch_size=args.batch_size,
+                                           steps=args.steps, seed=args.seed),
+                      mesh=mesh)
+    params, report = trainer.train(windows, valid)
+    print(json.dumps({"steps": report["steps"],
+                      "final_loss": report["final_loss"],
+                      "seconds": round(report["seconds"], 2)}))
+    if args.checkpoint and (not args.distributed
+                            or process_info()["process_index"] == 0):
+        store = CheckpointStore(args.checkpoint)
+        version = store.save("cli", args.model, params,
+                             metadata={"window": args.window})
+        print(f"checkpoint: {args.checkpoint}/cli/{args.model}/v{version}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="swx")
     parser.add_argument("-v", "--verbose", action="store_true")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p_run = sub.add_parser("run", help="run a full instance")
+    p_run = sub.add_parser("run", help="run a full instance (or a subset "
+                                       "of services against a wire bus)")
     p_run.add_argument("--config", help="instance YAML")
     p_run.add_argument("--port", type=int, help="REST port")
     p_run.add_argument("--gateway-port", type=int, default=47800)
+    p_run.add_argument("--services",
+                       help="comma-separated subset to host in THIS process")
+    p_run.add_argument("--bus", metavar="HOST:PORT",
+                       help="attach to a wire bus broker instead of an "
+                            "in-proc bus (see `swx serve-bus`)")
+    p_run.add_argument("--api-port", type=int,
+                       help="serve this process's services to peers on "
+                            "this port (0 = ephemeral)")
+    p_run.add_argument("--remote", action="append", metavar="SVC=HOST:PORT",
+                       help="peer process hosting SVC (repeatable)")
+    p_run.add_argument("--no-tenants", action="store_true",
+                       help="don't create tenants here (a peer process "
+                            "broadcasts them over the shared bus)")
+
+    p_bus = sub.add_parser("serve-bus", help="run the wire bus broker")
+    p_bus.add_argument("--host", default="127.0.0.1")
+    p_bus.add_argument("--port", type=int, default=47900)
+    p_bus.add_argument("--partitions", type=int, default=4)
+    p_bus.add_argument("--retention", type=int, default=4096)
 
     p_sim = sub.add_parser("simulate", help="stream SWB1 at a TCP gateway")
     p_sim.add_argument("--host", default="127.0.0.1")
@@ -197,6 +404,24 @@ def main(argv=None) -> int:
 
     sub.add_parser("bench", help="run the benchmark (see bench.py flags)")
 
+    p_train = sub.add_parser("train", help="train a model (optionally "
+                                           "multi-host via --distributed)")
+    p_train.add_argument("--model", default="lstm")
+    p_train.add_argument("--window", type=int, default=64)
+    p_train.add_argument("--devices", type=int, default=1024)
+    p_train.add_argument("--history", type=int, default=192)
+    p_train.add_argument("--batch-size", type=int, default=1024)
+    p_train.add_argument("--steps", type=int, default=200)
+    p_train.add_argument("--seed", type=int, default=0)
+    p_train.add_argument("--checkpoint", help="directory to save params to")
+    p_train.add_argument("--distributed", action="store_true",
+                         help="join the multi-host process group "
+                              "(SWX_COORDINATOR/SWX_NUM_PROCESSES/"
+                              "SWX_PROCESS_ID or the flags below)")
+    p_train.add_argument("--coordinator", help="host:port of rank 0")
+    p_train.add_argument("--num-processes", type=int)
+    p_train.add_argument("--process-id", type=int)
+
     args, extra = parser.parse_known_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -206,7 +431,8 @@ def main(argv=None) -> int:
         import subprocess
 
         return subprocess.call([sys.executable, "bench.py", *extra])
-    coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo}[args.cmd]
+    coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
+            "train": cmd_train, "serve-bus": cmd_serve_bus}[args.cmd]
     return asyncio.run(coro(args))
 
 

@@ -74,6 +74,18 @@ class TenantConfig:
     def section(self, name: str, default: Optional[dict] = None) -> dict:
         return dict(self.sections.get(name, default or {}))
 
+    def equivalent(self, other: object) -> bool:
+        """Semantic equality INCLUDING sections (dataclass `==` skips
+        them, and object identity breaks once configs cross the wire —
+        a broadcast record decodes to a copy). The engine-respin guard
+        keys on this: same content → keep the running engine."""
+        return (isinstance(other, TenantConfig)
+                and self.tenant_id == other.tenant_id
+                and self.name == other.name
+                and tuple(self.authorized_user_ids)
+                == tuple(other.authorized_user_ids)
+                and self.sections == other.sections)
+
     def with_section(self, name: str, values: dict) -> "TenantConfig":
         sections = dict(self.sections)
         sections[name] = {**sections.get(name, {}), **values}

@@ -1,0 +1,248 @@
+"""Wire codec: a restricted, numpy-aware binary value encoding.
+
+The process-split deployment (kernel/wire.py) needs the same records the
+in-proc bus carries — columnar batches, tenant configs, per-event
+dataclasses — to cross a socket. The reference serializes with protobuf
+plus ~25k lines of generated code and hand-written converters
+[SURVEY.md §2.1 "Protobuf wire model"]; this codec gets the same
+capability from the dataclass definitions themselves:
+
+- scalars/str/bytes/list/dict encode with explicit tags (little-endian,
+  length-prefixed) — no pickle, ever;
+- numpy arrays encode as dtype + shape + raw buffer (the columnar hot
+  path stays columnar on the wire: one header + one memcpy per column);
+- dataclasses and enums encode by REGISTERED name + field dict. Decode
+  only constructs classes that were explicitly registered, so a hostile
+  peer cannot instantiate arbitrary types (the classic pickle hole).
+
+Registration covers the domain model, batches, events, and config
+(`register_module` scans a module once at import).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import Any
+
+import numpy as np
+
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+# tags
+T_NONE, T_TRUE, T_FALSE, T_INT, T_FLOAT = 0, 1, 2, 3, 4
+T_STR, T_BYTES, T_LIST, T_DICT, T_NDARRAY = 5, 6, 7, 8, 9
+T_DATACLASS, T_ENUM, T_TUPLE = 10, 11, 12
+
+_CLASSES: dict[str, type] = {}
+_ENUMS: dict[str, type] = {}
+
+
+def register_class(cls: type) -> type:
+    """Allow `cls` (a dataclass) on the wire."""
+    _CLASSES[cls.__name__] = cls
+    return cls
+
+
+def register_enum(cls: type) -> type:
+    _ENUMS[cls.__name__] = cls
+    return cls
+
+
+def register_module(mod) -> None:
+    """Register every dataclass and Enum defined in `mod`."""
+    for name in dir(mod):
+        obj = getattr(mod, name)
+        if not isinstance(obj, type) or obj.__module__ != mod.__name__:
+            continue
+        if dataclasses.is_dataclass(obj):
+            register_class(obj)
+        elif issubclass(obj, enum.Enum):
+            register_enum(obj)
+
+
+def _register_defaults() -> None:
+    from sitewhere_tpu import config as _config
+    from sitewhere_tpu.domain import batch as _batch
+    from sitewhere_tpu.domain import events as _events
+    from sitewhere_tpu.domain import model as _model
+
+    for mod in (_batch, _events, _model, _config):
+        register_module(mod)
+
+
+def _w_str(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    out += _U32.pack(len(b))
+    out += b
+
+
+def _encode_into(out: bytearray, v: Any) -> None:
+    if v is None:
+        out.append(T_NONE)
+    elif v is True:
+        out.append(T_TRUE)
+    elif v is False:
+        out.append(T_FALSE)
+    elif isinstance(v, int) and not isinstance(v, enum.Enum):
+        out.append(T_INT)
+        out += _I64.pack(v)
+    elif isinstance(v, float):
+        out.append(T_FLOAT)
+        out += _F64.pack(v)
+    elif isinstance(v, str):
+        out.append(T_STR)
+        _w_str(out, v)
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        out.append(T_BYTES)
+        b = bytes(v)
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, np.ndarray):
+        out.append(T_NDARRAY)
+        a = np.ascontiguousarray(v)
+        _w_str(out, a.dtype.str)
+        out += _U32.pack(a.ndim)
+        for d in a.shape:
+            out += _U32.pack(d)
+        raw = a.tobytes()
+        out += _U32.pack(len(raw))
+        out += raw
+    elif isinstance(v, (np.integer,)):
+        out.append(T_INT)
+        out += _I64.pack(int(v))
+    elif isinstance(v, (np.floating,)):
+        out.append(T_FLOAT)
+        out += _F64.pack(float(v))
+    elif isinstance(v, enum.Enum):
+        cls_name = type(v).__name__
+        if cls_name not in _ENUMS:
+            raise TypeError(f"enum {cls_name} not registered for the wire")
+        out.append(T_ENUM)
+        _w_str(out, cls_name)
+        _encode_into(out, v.value)
+    elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+        cls_name = type(v).__name__
+        if cls_name not in _CLASSES:
+            raise TypeError(f"dataclass {cls_name} not registered for the wire")
+        out.append(T_DATACLASS)
+        _w_str(out, cls_name)
+        flds = dataclasses.fields(v)
+        out += _U32.pack(len(flds))
+        for f in flds:
+            _w_str(out, f.name)
+            _encode_into(out, getattr(v, f.name))
+    elif isinstance(v, tuple):
+        out.append(T_TUPLE)
+        out += _U32.pack(len(v))
+        for item in v:
+            _encode_into(out, item)
+    elif isinstance(v, list):
+        out.append(T_LIST)
+        out += _U32.pack(len(v))
+        for item in v:
+            _encode_into(out, item)
+    elif isinstance(v, dict):
+        out.append(T_DICT)
+        out += _U32.pack(len(v))
+        for k, item in v.items():
+            _encode_into(out, k)
+            _encode_into(out, item)
+    else:
+        raise TypeError(f"type {type(v).__name__} not encodable for the wire")
+
+
+def encode(v: Any) -> bytes:
+    if not _CLASSES:
+        _register_defaults()
+    out = bytearray()
+    _encode_into(out, v)
+    return bytes(out)
+
+
+def _r_str(mv: memoryview, o: int) -> tuple[str, int]:
+    (n,) = _U32.unpack_from(mv, o)
+    o += 4
+    return bytes(mv[o:o + n]).decode("utf-8"), o + n
+
+
+def _decode_from(mv: memoryview, o: int) -> tuple[Any, int]:
+    tag = mv[o]
+    o += 1
+    if tag == T_NONE:
+        return None, o
+    if tag == T_TRUE:
+        return True, o
+    if tag == T_FALSE:
+        return False, o
+    if tag == T_INT:
+        return _I64.unpack_from(mv, o)[0], o + 8
+    if tag == T_FLOAT:
+        return _F64.unpack_from(mv, o)[0], o + 8
+    if tag == T_STR:
+        return _r_str(mv, o)
+    if tag == T_BYTES:
+        (n,) = _U32.unpack_from(mv, o)
+        o += 4
+        return bytes(mv[o:o + n]), o + n
+    if tag == T_NDARRAY:
+        dtype, o = _r_str(mv, o)
+        (ndim,) = _U32.unpack_from(mv, o)
+        o += 4
+        shape = []
+        for _ in range(ndim):
+            (d,) = _U32.unpack_from(mv, o)
+            shape.append(d)
+            o += 4
+        (nbytes,) = _U32.unpack_from(mv, o)
+        o += 4
+        a = np.frombuffer(mv[o:o + nbytes], np.dtype(dtype)).reshape(shape)
+        return a.copy(), o + nbytes  # own the memory past the frame
+    if tag in (T_LIST, T_TUPLE):
+        (n,) = _U32.unpack_from(mv, o)
+        o += 4
+        items = []
+        for _ in range(n):
+            item, o = _decode_from(mv, o)
+            items.append(item)
+        return (tuple(items) if tag == T_TUPLE else items), o
+    if tag == T_DICT:
+        (n,) = _U32.unpack_from(mv, o)
+        o += 4
+        d = {}
+        for _ in range(n):
+            k, o = _decode_from(mv, o)
+            v, o = _decode_from(mv, o)
+            d[k] = v
+        return d, o
+    if tag == T_ENUM:
+        cls_name, o = _r_str(mv, o)
+        value, o = _decode_from(mv, o)
+        return _ENUMS[cls_name](value), o
+    if tag == T_DATACLASS:
+        cls_name, o = _r_str(mv, o)
+        (n,) = _U32.unpack_from(mv, o)
+        o += 4
+        kwargs = {}
+        for _ in range(n):
+            name, o = _r_str(mv, o)
+            value, o = _decode_from(mv, o)
+            kwargs[name] = value
+        cls = _CLASSES.get(cls_name)
+        if cls is None:
+            raise ValueError(f"dataclass {cls_name} not registered (wire "
+                             "decode refuses unknown types)")
+        return cls(**kwargs), o
+    raise ValueError(f"bad wire tag {tag}")
+
+
+def decode(payload: bytes | memoryview) -> Any:
+    if not _CLASSES:
+        _register_defaults()
+    v, o = _decode_from(memoryview(payload), 0)
+    if o != len(payload):
+        raise ValueError(f"trailing bytes after wire value ({len(payload)-o})")
+    return v

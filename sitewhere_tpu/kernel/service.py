@@ -98,12 +98,13 @@ class Service(LifecycleComponent):
     async def start_tenant_engine(self, tenant: TenantConfig) -> TenantEngine:
         existing = self.engines.get(tenant.tenant_id)
         if existing is not None:
-            if (existing.tenant is tenant
+            if (existing.tenant.equivalent(tenant)
                     and existing.status == LifecycleStatus.STARTED):
-                # already built from this exact config: the manager's
+                # already built from equivalent config: the manager's
                 # bootstrap scan and the tenant-model-updates broadcast
-                # race on a freshly added tenant — creating twice would
-                # needlessly tear down a just-started engine
+                # race on a freshly added tenant (and wire-bus broadcasts
+                # decode to copies) — creating twice would needlessly
+                # tear down a just-started engine and its state
                 return existing
             await existing.stop()
         engine = self.create_tenant_engine(tenant)
@@ -185,7 +186,8 @@ class ServiceRuntime(LifecycleComponent):
     """The whole instance: bus + services + tenants (reference: an
     instance's set of microservices plus its Kafka cluster)."""
 
-    def __init__(self, settings: Optional[InstanceSettings] = None):
+    def __init__(self, settings: Optional[InstanceSettings] = None,
+                 bus: Optional[Any] = None):
         settings = settings or InstanceSettings()
         super().__init__(f"instance-{settings.instance_id}")
         self.settings = settings
@@ -193,10 +195,18 @@ class ServiceRuntime(LifecycleComponent):
         self.metrics = MetricsRegistry()
         from sitewhere_tpu.kernel.tracing import Tracer
         self.tracer = Tracer(sample=settings.trace_sample)
-        self.bus = EventBus(default_partitions=settings.bus_default_partitions,
-                            retention=settings.bus_retention)
-        self.add_child(self.bus)
+        # `bus` may be a RemoteEventBus (kernel/wire.py): this process
+        # then shares one broker's topics with peer processes — the
+        # process-split deployment the reference runs as 14 JVMs
+        self.bus = bus if bus is not None else EventBus(
+            default_partitions=settings.bus_default_partitions,
+            retention=settings.bus_retention)
+        if isinstance(self.bus, LifecycleComponent):
+            self.add_child(self.bus)
+        else:
+            self._external_bus = self.bus
         self.services: dict[str, Service] = {}
+        self.remotes: dict[str, Any] = {}   # identifier -> RemoteService
         self.tenants: dict[str, TenantConfig] = {}
 
     # -- wiring ------------------------------------------------------------
@@ -208,9 +218,22 @@ class ServiceRuntime(LifecycleComponent):
         self.add_child(service)
         return service
 
+    def add_remote_service(self, identifier: str, host: str,
+                           port: int) -> Any:
+        """Register a peer process's service: `api(identifier)` and
+        `wait_for_engine` resolve to wire proxies (kernel/wire.py)."""
+        from sitewhere_tpu.kernel.wire import ApiChannel, RemoteService
+
+        remote = RemoteService(identifier, ApiChannel(host, port))
+        self.remotes[identifier] = remote
+        return remote
+
     def api(self, identifier: str) -> Any:
         """In-proc equivalent of a gRPC ApiChannel to `identifier`."""
-        return self.services[identifier].api()
+        svc = self.services.get(identifier)
+        if svc is not None:
+            return svc.api()
+        return self.remotes[identifier].api()
 
     async def wait_for_api(self, identifier: str, timeout: float = 10.0) -> Any:
         """Wait-for-available retry (reference: ApiChannel.waitForApiAvailable)."""
@@ -232,6 +255,9 @@ class ServiceRuntime(LifecycleComponent):
         start order across services is scheduler timing — consumers that
         need a peer's engine must wait, exactly like the reference's
         ApiChannel wait-for-available."""
+        remote = self.remotes.get(identifier)
+        if remote is not None and identifier not in self.services:
+            return await remote.wait_engine(tenant_id, timeout=timeout)
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
             svc = self.services.get(identifier)
@@ -289,11 +315,13 @@ class ServiceRuntime(LifecycleComponent):
             def ready(s: Service) -> bool:
                 eng = s.engines.get(tenant_id)
                 if present:
-                    # engine must be running *and* built from the current
-                    # config object (update spins a fresh engine, §3.5)
+                    # engine must be running *and* built from equivalent
+                    # config (update spins a fresh engine, §3.5; equality
+                    # is semantic — wire broadcasts decode to copies)
                     return (eng is not None
                             and eng.status == LifecycleStatus.STARTED
-                            and eng.tenant is current)
+                            and current is not None
+                            and eng.tenant.equivalent(current))
                 return eng is None
             if all(ready(s) for s in multitenant):
                 return
@@ -303,6 +331,20 @@ class ServiceRuntime(LifecycleComponent):
                     f"tenant {tenant_id} engines not {'ready' if present else 'removed'}"
                     f" in {timeout}s: {lagging}")
             await asyncio.sleep(0.005)
+
+    # -- external (wire) bus lifecycle --------------------------------------
+
+    async def _do_initialize(self, monitor: LifecycleProgressMonitor) -> None:
+        eb = getattr(self, "_external_bus", None)
+        if eb is not None:
+            await eb.initialize()
+
+    async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
+        eb = getattr(self, "_external_bus", None)
+        if eb is not None:
+            await eb.stop()
+        for remote in self.remotes.values():
+            remote.channel.close()
 
     def health(self) -> dict:
         return self.state_tree()

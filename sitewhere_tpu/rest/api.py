@@ -376,7 +376,18 @@ class RestServer(LifecycleComponent):
 
     async def get_topics(self, req: Request):
         bus = self.runtime.bus
-        return {t: bus.end_offsets(t) for t in bus.topic_names()}
+        import inspect
+
+        names = bus.topic_names()
+        if inspect.isawaitable(names):  # wire bus: the broker answers
+            names = await names
+        out = {}
+        for t in names:
+            offs = bus.end_offsets(t)
+            if inspect.isawaitable(offs):
+                offs = await offs
+            out[t] = offs
+        return out
 
     # -- handlers: users/tenants -------------------------------------------
 

@@ -13,6 +13,7 @@ the same at-least-once semantics.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import time
 
@@ -49,7 +50,7 @@ class InboundProcessor(BackgroundTaskComponent):
         tenant_id = engine.tenant_id
         # engines start in broadcast order across services — wait, don't race
         dm = await runtime.wait_for_engine("device-management", tenant_id)
-        dm_service = runtime.services["device-management"]
+        dm_service = runtime.services.get("device-management")
         decoded_topic = engine.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED)
         inbound_topic = engine.tenant_topic(TopicNaming.INBOUND_EVENTS)
         unregistered_topic = engine.tenant_topic(TopicNaming.UNREGISTERED_DEVICES)
@@ -61,12 +62,15 @@ class InboundProcessor(BackgroundTaskComponent):
         try:
             while True:
                 # re-resolve each round: a tenant update swaps the dm engine
-                dm = dm_service.engines.get(tenant_id, dm)
+                if dm_service is not None:
+                    dm = dm_service.engines.get(tenant_id, dm)
                 for record in await consumer.poll(max_records=256, timeout=0.2):
                     batch = record.value
                     t_span = time.monotonic()
                     if isinstance(batch, (MeasurementBatch, LocationBatch)):
                         mask = dm.registered_mask(batch.device_index)
+                        if inspect.isawaitable(mask):
+                            mask = await mask  # device-mgmt in a peer process
                         n_bad = int((~mask).sum())
                         if n_bad:
                             dropped.inc(n_bad)

@@ -35,6 +35,7 @@ Two scoring modes [SURVEY.md §7 hard part b]:
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import time
 from dataclasses import dataclass
@@ -270,8 +271,10 @@ class RuleProcessor(BackgroundTaskComponent):
                         consumer.commit(ckpt[1])
                         ckpt = None
                     if ckpt is None and sink.pending_n == 0:
-                        ckpt = (sink.dispatch_count,
-                                consumer.snapshot_positions())
+                        snap = consumer.snapshot_positions()
+                        if inspect.isawaitable(snap):
+                            snap = await snap  # consumer on a wire bus
+                        ckpt = (sink.dispatch_count, snap)
         finally:
             consumer.close()
 

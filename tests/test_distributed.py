@@ -1,0 +1,100 @@
+"""DCN/multi-host entry test (SURVEY.md §2.4/§5.8): two REAL OS
+processes join a jax.distributed process group on the CPU backend, form
+the same global mesh, run lockstep DP training steps, and converge to
+exactly the same loss as a single-process run on identical data — the
+same `initialize_distributed` + `make_global_mesh` path a v5p multi-host
+job uses, minus the hardware."""
+
+import json
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = r'''
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from sitewhere_tpu.parallel.distributed import (
+    initialize_distributed, make_global_mesh, process_info)
+
+joined = initialize_distributed()  # SWX_* env contract
+assert joined, "worker expected a coordinator"
+info = process_info()
+assert info["global_devices"] == 4, info   # 2 procs x 2 virtual devices
+
+import numpy as np
+from sitewhere_tpu.models import build_model
+from sitewhere_tpu.training.trainer import Trainer, TrainerConfig
+
+mesh = make_global_mesh(model=1)           # data axis = all 4 devices
+model = build_model("lstm", window=16, hidden=8)
+rng = np.random.default_rng(0)             # same data in every process
+windows = rng.normal(10.0, 2.0, (256, 16)).astype(np.float32)
+valid = np.ones_like(windows, dtype=bool)
+trainer = Trainer(model, TrainerConfig(batch_size=64, steps=5, log_every=1),
+                  mesh=mesh)
+params, report = trainer.train(windows, valid)
+print("RESULT " + json.dumps({"rank": info["process_index"],
+                              "losses": report["losses"],
+                              "devices": info["global_devices"]}))
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_global_mesh_matches_single_process(tmp_path):
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ,
+                   SWX_COORDINATOR=f"127.0.0.1:{port}",
+                   SWX_NUM_PROCESSES="2",
+                   SWX_PROCESS_ID=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER.replace("@REPO@", repo)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env))
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err.decode()[-2000:]
+        for line in out.decode().splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["rank"]] = r
+    assert set(results) == {0, 1}
+    # SPMD: both processes computed the identical (global) losses
+    assert results[0]["losses"] == results[1]["losses"]
+    assert results[0]["devices"] == 4
+
+    # single-process reference on the same data: must match exactly —
+    # the global mesh changes WHERE shards live, not the math
+    from sitewhere_tpu.models import build_model
+    from sitewhere_tpu.parallel.mesh import make_mesh
+    from sitewhere_tpu.training.trainer import Trainer, TrainerConfig
+
+    import jax
+
+    mesh = make_mesh(model=1, devices=jax.devices()[:4])
+    model = build_model("lstm", window=16, hidden=8)
+    rng = np.random.default_rng(0)
+    windows = rng.normal(10.0, 2.0, (256, 16)).astype(np.float32)
+    valid = np.ones_like(windows, dtype=bool)
+    trainer = Trainer(model, TrainerConfig(batch_size=64, steps=5,
+                                           log_every=1), mesh=mesh)
+    _, report = trainer.train(windows, valid)
+    np.testing.assert_allclose(report["losses"], results[0]["losses"],
+                               rtol=1e-5)

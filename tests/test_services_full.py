@@ -159,8 +159,18 @@ def test_outbound_connectors_filtering(run, tmp_path):
             assert all(r.score.min() >= 4.0 for r in anomalies.records)
             assert engine.connectors["all"].records
 
-            lines = (tmp_path / "out.jsonl").read_text().strip().splitlines()
-            assert len(lines) >= 20
+            # the jsonl exporter is an independent consumer group — its
+            # progress is not ordered against the anomaly connector's,
+            # so wait for it on its own terms
+            def jsonl_lines():
+                try:
+                    return (tmp_path / "out.jsonl").read_text() \
+                        .strip().splitlines()
+                except FileNotFoundError:
+                    return []
+
+            await wait_until(lambda: len(jsonl_lines()) >= 20, timeout=15.0)
+            lines = jsonl_lines()
             assert json.loads(lines[0])["kind"] == "measurements"
 
     run(main())
