@@ -66,6 +66,12 @@ _COLOCATE = {
                             "batch-operations"},
     "label-generation": {"device-management", "asset-management"},
     "rule-processing": {"event-management", "device-state"},
+    # the REST facade calls nearly every engine synchronously — the
+    # instance-management process is the full-facade process by design
+    "instance-management": {"device-management", "event-management",
+                            "asset-management", "device-state",
+                            "rule-processing", "label-generation",
+                            "batch-operations", "schedule-management"},
 }
 # services whose consumers guard for awaitable (wire-proxy) results —
 # the only identifiers --remote currently supports
@@ -126,6 +132,8 @@ def _build_runtime(settings, tenants, services=None, bus=None, remotes=None):
 
 def _parse_addr(addr: str) -> tuple[str, int]:
     host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        raise SystemExit(f"swx: expected HOST:PORT, got {addr!r}")
     return host or "127.0.0.1", int(port)
 
 
@@ -183,7 +191,10 @@ async def cmd_run(args) -> int:
     services = set(args.services.split(",")) if args.services else None
     remotes = {}
     for spec in args.remote or ():
-        identifier, _, addr = spec.partition("=")
+        identifier, eq, addr = spec.partition("=")
+        if not eq:
+            raise SystemExit(
+                f"swx: --remote wants SVC=HOST:PORT, got {spec!r}")
         remotes[identifier] = _parse_addr(addr)
 
     rt = _build_runtime(settings, tenants, services=services, bus=bus,

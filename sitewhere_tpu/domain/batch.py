@@ -37,8 +37,26 @@ import numpy as np
 MAGIC = b"SWB1"
 MSG_MEASUREMENTS = 1
 MSG_LOCATIONS = 2
+# compact agent protocol (reference: the separate `sitewhere.proto`
+# device payloads — RegisterDevice / RegistrationAck [SURVEY.md §2.1]):
+# a device self-registers over ANY transport that carries SWB1 frames
+# (MQTT/TCP/WebSocket) and receives a binary ack on its command topic
+MSG_REGISTRATION = 3
+MSG_REGISTRATION_ACK = 4
 
 _HEADER = struct.Struct("<4sBBI")
+
+
+def _w_str(parts: list, s: str) -> None:
+    b = (s or "").encode("utf-8")
+    parts.append(len(b).to_bytes(2, "little"))
+    parts.append(b)
+
+
+def _r_str(mv: memoryview, o: int) -> tuple[str, int]:
+    n = int.from_bytes(mv[o:o + 2], "little")
+    o += 2
+    return bytes(mv[o:o + n]).decode("utf-8"), o + n
 
 
 @dataclass(slots=True)
@@ -191,6 +209,90 @@ class RegistrationBatch:
 
     def __len__(self) -> int:
         return len(self.device_tokens)
+
+    # -- SWB1 agent codec (MSG_REGISTRATION) --------------------------------
+
+    def encode(self) -> bytes:
+        import json as _json
+
+        parts = [_HEADER.pack(MAGIC, MSG_REGISTRATION, 0, len(self))]
+        _w_str(parts, self.device_type_token)
+        _w_str(parts, self.area_token or "")
+        _w_str(parts, self.customer_token or "")
+        _w_str(parts, _json.dumps(self.metadata) if self.metadata else "")
+        for token in self.device_tokens:
+            _w_str(parts, token)
+        return b"".join(parts)
+
+    @staticmethod
+    def decode(payload: bytes | memoryview,
+               ctx: BatchContext) -> "RegistrationBatch":
+        import json as _json
+
+        magic, msg_type, _flags, n = _HEADER.unpack_from(payload, 0)
+        if magic != MAGIC or msg_type != MSG_REGISTRATION:
+            raise ValueError(f"not an SWB1 registration (type={msg_type})")
+        mv = memoryview(payload)
+        o = _HEADER.size
+        dt_token, o = _r_str(mv, o)
+        area_token, o = _r_str(mv, o)
+        customer_token, o = _r_str(mv, o)
+        meta_json, o = _r_str(mv, o)
+        tokens = []
+        for _ in range(n):
+            t, o = _r_str(mv, o)
+            tokens.append(t)
+        return RegistrationBatch(ctx, tokens, dt_token,
+                                 area_token=area_token or None,
+                                 customer_token=customer_token or None,
+                                 metadata=_json.loads(meta_json)
+                                 if meta_json else {})
+
+
+# registration ack statuses (MSG_REGISTRATION_ACK)
+ACK_NEW = 0            # device created + assigned
+ACK_ALREADY = 1        # token already registered (redelivery/idempotent)
+ACK_REJECTED = 2       # policy refused (unknown type, registration off)
+
+
+@dataclass(slots=True)
+class RegistrationAck:
+    """Binary ack sent back down the device's command topic after a
+    MSG_REGISTRATION round trip (reference: RegistrationAck proto)."""
+
+    device_tokens: list[str]
+    status: list[int]          # ACK_* per token
+    device_index: list[int]    # dense index per token (-1 if rejected)
+
+    def __len__(self) -> int:
+        return len(self.device_tokens)
+
+    def encode(self) -> bytes:
+        parts = [_HEADER.pack(MAGIC, MSG_REGISTRATION_ACK, 0, len(self))]
+        for token, st, idx in zip(self.device_tokens, self.status,
+                                  self.device_index):
+            _w_str(parts, token)
+            parts.append(bytes([st]))
+            parts.append(int(idx & 0xFFFFFFFF).to_bytes(4, "little"))
+        return b"".join(parts)
+
+    @staticmethod
+    def decode(payload: bytes | memoryview) -> "RegistrationAck":
+        magic, msg_type, _flags, n = _HEADER.unpack_from(payload, 0)
+        if magic != MAGIC or msg_type != MSG_REGISTRATION_ACK:
+            raise ValueError(f"not an SWB1 registration ack (type={msg_type})")
+        mv = memoryview(payload)
+        o = _HEADER.size
+        tokens, status, index = [], [], []
+        for _ in range(n):
+            t, o = _r_str(mv, o)
+            tokens.append(t)
+            status.append(mv[o])
+            o += 1
+            raw = int.from_bytes(mv[o:o + 4], "little")
+            index.append(raw if raw != 0xFFFFFFFF else -1)
+            o += 4
+        return RegistrationAck(tokens, status, index)
 
 
 @dataclass(slots=True)

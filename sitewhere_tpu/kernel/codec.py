@@ -39,6 +39,7 @@ T_DATACLASS, T_ENUM, T_TUPLE = 10, 11, 12
 
 _CLASSES: dict[str, type] = {}
 _ENUMS: dict[str, type] = {}
+_defaults_loaded = False
 
 
 def register_class(cls: type) -> type:
@@ -65,6 +66,10 @@ def register_module(mod) -> None:
 
 
 def _register_defaults() -> None:
+    global _defaults_loaded
+    if _defaults_loaded:
+        return
+    _defaults_loaded = True
     from sitewhere_tpu import config as _config
     from sitewhere_tpu.domain import batch as _batch
     from sitewhere_tpu.domain import events as _events
@@ -156,8 +161,7 @@ def _encode_into(out: bytearray, v: Any) -> None:
 
 
 def encode(v: Any) -> bytes:
-    if not _CLASSES:
-        _register_defaults()
+    _register_defaults()
     out = bytearray()
     _encode_into(out, v)
     return bytes(out)
@@ -240,8 +244,7 @@ def _decode_from(mv: memoryview, o: int) -> tuple[Any, int]:
 
 
 def decode(payload: bytes | memoryview) -> Any:
-    if not _CLASSES:
-        _register_defaults()
+    _register_defaults()
     v, o = _decode_from(memoryview(payload), 0)
     if o != len(payload):
         raise ValueError(f"trailing bytes after wire value ({len(payload)-o})")

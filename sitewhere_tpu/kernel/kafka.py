@@ -1,0 +1,178 @@
+"""Real-Kafka adapter for the event bus Protocol.
+
+SURVEY.md §5.8: "the Kafka event bus stays intact" is north-star text —
+deployments that already run Kafka plug the SAME service code into a
+real cluster by constructing the runtime with
+`ServiceRuntime(settings, bus=KafkaEventBus("broker:9092"))`. Values
+cross Kafka in the restricted wire codec (kernel/codec.py), so columnar
+batches stay columnar; keys map to Kafka keys, preserving the per-key
+ordering contract; consumer groups / committed offsets / rebalance are
+Kafka's own.
+
+This image has no Kafka client library (aiokafka is not baked in), so
+the adapter import-gates: constructing it without aiokafka raises a
+clear error, and the bus CONTRACT tests (tests/test_bus_contract.py)
+run the identical suite against the in-proc and wire buses — the Kafka
+rows activate automatically wherever aiokafka + a broker exist
+(`SWX_KAFKA_BOOTSTRAP` env).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Iterable, Optional
+
+from sitewhere_tpu.kernel import codec
+from sitewhere_tpu.kernel.bus import TopicRecord
+
+logger = logging.getLogger(__name__)
+
+try:  # gated: not baked into this image
+    import aiokafka  # type: ignore
+except ImportError:  # pragma: no cover - exercised only without the lib
+    aiokafka = None
+
+
+class KafkaEventBus:
+    """`EventBus` surface over a real Kafka cluster (aiokafka)."""
+
+    def __init__(self, bootstrap_servers: str, client_id: str = "swx"):
+        if aiokafka is None:
+            raise RuntimeError(
+                "KafkaEventBus needs the aiokafka package; this image "
+                "does not bake it in — use the in-proc bus or the wire "
+                "bus broker (`swx serve-bus`) instead")
+        self.bootstrap = bootstrap_servers
+        self.client_id = client_id
+        self._producer: Optional["aiokafka.AIOKafkaProducer"] = None
+        self._consumers: list["KafkaBusConsumer"] = []
+
+    # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
+    async def initialize(self) -> None:
+        self._producer = aiokafka.AIOKafkaProducer(
+            bootstrap_servers=self.bootstrap, client_id=self.client_id,
+            value_serializer=codec.encode,
+            key_serializer=lambda k: k.encode() if k else None)
+        await self._producer.start()
+
+    async def start(self) -> None:
+        if self._producer is None:
+            await self.initialize()
+
+    async def stop(self) -> None:
+        for consumer in list(self._consumers):
+            await consumer.aclose()
+        if self._producer is not None:
+            await self._producer.stop()
+            self._producer = None
+
+    def create_topic(self, name: str, **kwargs: Any) -> None:
+        pass  # broker-side auto-create / admin tooling owns topics
+
+    async def produce(self, topic: str, value: Any, *,
+                      key: Optional[str] = None,
+                      partition: Optional[int] = None) -> tuple[int, int]:
+        meta = await self._producer.send_and_wait(
+            topic, value, key=key, partition=partition)
+        return meta.partition, meta.offset
+
+    def produce_nowait(self, topic: str, value: Any, *,
+                       key: Optional[str] = None,
+                       partition: Optional[int] = None) -> None:
+        asyncio.get_running_loop().create_task(
+            self.produce(topic, value, key=key, partition=partition))
+
+    def subscribe(self, topics: Iterable[str] | str, *, group: str,
+                  name: Optional[str] = None) -> "KafkaBusConsumer":
+        if isinstance(topics, str):
+            topics = [topics]
+        consumer = KafkaBusConsumer(self, list(topics), group,
+                                    name or group)
+        self._consumers.append(consumer)
+        return consumer
+
+
+class KafkaBusConsumer:
+    """`BusConsumer` surface over aiokafka (lazy start on first poll)."""
+
+    def __init__(self, bus: KafkaEventBus, topics: list, group: str,
+                 name: str):
+        self._bus = bus
+        self._topics = topics
+        self.group = group
+        self.name = name
+        self._consumer: Optional["aiokafka.AIOKafkaConsumer"] = None
+        self._closed = False
+
+    async def _ensure(self) -> None:
+        if self._consumer is None:
+            self._consumer = aiokafka.AIOKafkaConsumer(
+                *self._topics,
+                bootstrap_servers=self._bus.bootstrap,
+                group_id=self.group, client_id=self.name,
+                enable_auto_commit=False,
+                auto_offset_reset="earliest",
+                value_deserializer=codec.decode,
+                key_deserializer=lambda k: k.decode() if k else None)
+            await self._consumer.start()
+
+    async def poll(self, *, max_records: int = 512,
+                   timeout: float = 1.0) -> list[TopicRecord]:
+        if self._closed:
+            return []
+        await self._ensure()
+        batches = await self._consumer.getmany(
+            timeout_ms=int(timeout * 1000), max_records=max_records)
+        out: list[TopicRecord] = []
+        for tp, records in batches.items():
+            for r in records:
+                out.append(TopicRecord(tp.topic, tp.partition, r.offset,
+                                       r.key, r.value, r.timestamp / 1e3))
+        return out
+
+    def commit(self, positions: Optional[dict] = None) -> None:
+        if self._consumer is None:
+            return
+        if positions is not None:
+            offsets = {aiokafka.TopicPartition(t, p): off
+                       for (t, p), off in positions.items()}
+            coro = self._consumer.commit(offsets)
+        else:
+            coro = self._consumer.commit()
+        asyncio.get_running_loop().create_task(_log_failure(coro))
+
+    def snapshot_positions(self):
+        return self._snapshot()
+
+    async def _snapshot(self) -> dict:
+        await self._ensure()
+        out = {}
+        for tp in self._consumer.assignment():
+            out[(tp.topic, tp.partition)] = await self._consumer.position(tp)
+        return out
+
+    def seek_to_beginning(self) -> None:
+        if self._consumer is not None:
+            asyncio.get_running_loop().create_task(
+                _log_failure(self._consumer.seek_to_beginning()))
+
+    async def aclose(self) -> None:
+        if not self._closed:
+            self._closed = True
+            if self._consumer is not None:
+                await self._consumer.stop()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            if self._consumer is not None:
+                asyncio.get_running_loop().create_task(
+                    _log_failure(self._consumer.stop()))
+
+
+async def _log_failure(coro) -> None:
+    try:
+        await coro
+    except Exception:  # noqa: BLE001 - background kafka op
+        logger.exception("kafka background operation failed")

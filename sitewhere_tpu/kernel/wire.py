@@ -424,21 +424,42 @@ class _LazyRemoteConsumer(RemoteBusConsumer):
                  name: str):
         super().__init__(client, cid=-1, group=group, name=name)
         self._topics = topics
+        self._seek_pending = False
 
     async def _ensure(self) -> None:
         if self.cid < 0:
             self.cid = await self._client.call(
                 "subscribe", topics=self._topics, group=self.group,
                 name=self.name)
+            if self._seek_pending:
+                self._seek_pending = False
+                await self._client.call("seek_begin", cid=self.cid)
 
     async def poll(self, *, max_records: int = 512,
                    timeout: float = 1.0) -> list[TopicRecord]:
         await self._ensure()
         return await super().poll(max_records=max_records, timeout=timeout)
 
+    def seek_to_beginning(self) -> None:
+        # valid before the first poll on the local BusConsumer — queue
+        # the intent and apply it right after the subscribe lands
+        if self.cid < 0:
+            self._seek_pending = True
+        else:
+            super().seek_to_beginning()
+
     def commit(self, positions: Optional[dict] = None) -> None:
         if self.cid >= 0:
             super().commit(positions)
+        elif positions:
+            # explicit positions before the first poll: subscribe first
+            async def ensure_then_commit():
+                await self._ensure()
+                rows = [[t, p, off] for (t, p), off in positions.items()]
+                await self._client.call("commit", cid=self.cid,
+                                        positions=rows)
+
+            self._client.spawn(ensure_then_commit())
 
     def close(self) -> None:
         if self.cid >= 0:

@@ -34,6 +34,8 @@ from sitewhere_tpu.domain.model import (
     Device,
     DeviceAssignment,
     DeviceCommand,
+    DeviceGroup,
+    DeviceGroupElement,
     DeviceType,
     Schedule,
     ScheduledJob,
@@ -293,6 +295,18 @@ class RestServer(LifecycleComponent):
         r("GET", r"/api/devices/(?P<token>[^/]+)", self.get_device)
         r("DELETE", r"/api/devices/(?P<token>[^/]+)", self.delete_device)
         r("GET", r"/api/devices/(?P<token>[^/]+)/state", self.get_device_state)
+        # device groups
+        r("GET", r"/api/devicegroups", self.list_device_groups)
+        r("POST", r"/api/devicegroups", self.create_device_group)
+        r("GET", r"/api/devicegroups/(?P<token>[^/]+)", self.get_device_group)
+        r("DELETE", r"/api/devicegroups/(?P<token>[^/]+)",
+          self.delete_device_group)
+        r("GET", r"/api/devicegroups/(?P<token>[^/]+)/elements",
+          self.list_group_elements)
+        r("POST", r"/api/devicegroups/(?P<token>[^/]+)/elements",
+          self.add_group_elements)
+        r("GET", r"/api/devicegroups/(?P<token>[^/]+)/devices",
+          self.expand_group)
         # assignments + events
         r("GET", r"/api/assignments", self.list_assignments)
         r("POST", r"/api/assignments", self.create_assignment)
@@ -415,7 +429,8 @@ class RestServer(LifecycleComponent):
         try:
             tenant = await self._im().create_tenant(
                 b["token"], b.get("name", ""), b.get("sections"),
-                tuple(b.get("authorizedUserIds", ())))
+                tuple(b.get("authorizedUserIds", ())),
+                template=b.get("template"))
         except ValueError as exc:
             raise HttpError(409, str(exc)) from exc
         return entity_to_dict(tenant)
@@ -783,6 +798,76 @@ class RestServer(LifecycleComponent):
         engine = self._engine(req, "rule-processing")
         engine.delete_script(req.params["name"])
         return {"deleted": req.params["name"]}
+
+    # -- handlers: device groups -------------------------------------------
+
+    def _group(self, req: Request):
+        g = self._dm(req).get_device_group_by_token(req.params["token"])
+        if g is None:
+            raise HttpError(404, f"unknown device group "
+                                 f"{req.params['token']!r}")
+        return g
+
+    async def list_device_groups(self, req: Request):
+        return [entity_to_dict(g)
+                for g in self._dm(req).list_device_groups()]
+
+    async def create_device_group(self, req: Request):
+        b = req.json()
+        if not b.get("token"):
+            raise HttpError(400, "token required")
+        try:
+            g = self._dm(req).create_device_group(DeviceGroup(
+                token=b["token"], name=b.get("name", b["token"]),
+                description=b.get("description", ""),
+                roles=tuple(b.get("roles", ()))))
+        except ValueError as exc:
+            raise HttpError(409, str(exc)) from exc
+        return entity_to_dict(g)
+
+    async def get_device_group(self, req: Request):
+        return entity_to_dict(self._group(req))
+
+    async def delete_device_group(self, req: Request):
+        g = self._group(req)
+        self._dm(req).delete_device_group(g.id)
+        return {"deleted": g.token}
+
+    async def list_group_elements(self, req: Request):
+        g = self._group(req)
+        return [entity_to_dict(el)
+                for el in self._dm(req).list_device_group_elements(g.id)]
+
+    async def add_group_elements(self, req: Request):
+        dm = self._dm(req)
+        g = self._group(req)
+        b = req.json()
+        elements = []
+        for item in b.get("elements", []):
+            device_id = nested_id = None
+            if "device" in item:
+                device = dm.get_device_by_token(item["device"])
+                if device is None:
+                    raise HttpError(400, f"unknown device {item['device']!r}")
+                device_id = device.id
+            elif "group" in item:
+                nested = dm.get_device_group_by_token(item["group"])
+                if nested is None:
+                    raise HttpError(400, f"unknown group {item['group']!r}")
+                nested_id = nested.id
+            else:
+                raise HttpError(400, "element needs 'device' or 'group'")
+            elements.append(DeviceGroupElement(
+                group_id=g.id, device_id=device_id,
+                nested_group_id=nested_id,
+                roles=tuple(item.get("roles", ()))))
+        stored = dm.add_device_group_elements(g.id, elements)
+        return [entity_to_dict(el) for el in stored]
+
+    async def expand_group(self, req: Request):
+        g = self._group(req)
+        return [entity_to_dict(d)
+                for d in self._dm(req).expand_group_devices(g.id)]
 
     # -- handlers: labels ---------------------------------------------------
 

@@ -136,6 +136,28 @@ class MqttDeliveryProvider:
         return n > 0
 
 
+class WebSocketDeliveryProvider:
+    """Deliver commands down a device's live WebSocket session (the
+    device connected to ws://.../ws/<device-token>)."""
+
+    def __init__(self, runtime, tenant_id: str,
+                 receiver_name: str = "websocket"):
+        self.runtime = runtime
+        self.tenant_id = tenant_id
+        self.receiver_name = receiver_name
+
+    async def deliver(self, device: Device, payload: bytes) -> bool:
+        try:
+            engine = self.runtime.api("event-sources").engine(self.tenant_id)
+            receiver = engine.receiver(self.receiver_name)
+        except KeyError:
+            return False
+        listener = getattr(receiver, "listener", None)
+        if listener is None or not hasattr(listener, "send"):
+            return False
+        return await listener.send(device.token, payload)
+
+
 class CommandDeliveryEngine(TenantEngine):
     def __init__(self, service: "CommandDeliveryService", tenant: TenantConfig):
         super().__init__(service, tenant)
@@ -147,7 +169,10 @@ class CommandDeliveryEngine(TenantEngine):
             "mqtt": MqttDeliveryProvider(
                 self.runtime, self.tenant_id,
                 receiver_name=cfg.get("mqtt_receiver", "mqtt"),
-                topic_prefix=cfg.get("mqtt_topic_prefix", "swx/commands/"))}
+                topic_prefix=cfg.get("mqtt_topic_prefix", "swx/commands/")),
+            "websocket": WebSocketDeliveryProvider(
+                self.runtime, self.tenant_id,
+                receiver_name=cfg.get("websocket_receiver", "websocket"))}
         self.default_encoder = cfg.get("encoder", "json")
         self.default_provider = cfg.get("provider", "queue")
         self.routes: dict[str, dict] = cfg.get("routes", {})
@@ -167,6 +192,19 @@ class CommandDeliveryEngine(TenantEngine):
         enc = self.encoders[r.get("encoder", self.default_encoder)]
         prov = self.providers[r.get("provider", self.default_provider)]
         return enc, prov
+
+    async def deliver_raw(self, device, payload: bytes) -> bool:
+        """Deliver a pre-encoded system payload (registration acks,
+        binary agent messages) down the device's routed provider —
+        bypasses the command encoder, keeps the transport routing."""
+        dm = self.runtime.api("device-management").management(self.tenant_id)
+        dtype = dm.get_device_type(device.device_type_id)
+        try:
+            _, provider = self.route(dtype.token if dtype else "")
+            return await provider.deliver(device, payload)
+        except Exception:  # noqa: BLE001 - delivery errors are data
+            logger.exception("raw delivery failed for %s", device.token)
+            return False
 
 
 class CommandDeliveryManager(BackgroundTaskComponent):

@@ -25,7 +25,13 @@ from __future__ import annotations
 import logging
 
 from sitewhere_tpu.config import TenantConfig
-from sitewhere_tpu.domain.batch import RegistrationBatch
+from sitewhere_tpu.domain.batch import (
+    ACK_ALREADY,
+    ACK_NEW,
+    ACK_REJECTED,
+    RegistrationAck,
+    RegistrationBatch,
+)
 from sitewhere_tpu.domain.model import Device, DeviceAssignment, DeviceType
 from sitewhere_tpu.kernel.bus import TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
@@ -68,25 +74,36 @@ class RegistrationManager(BackgroundTaskComponent):
                 for record in await consumer.poll(max_records=64, timeout=0.5):
                     value = record.value
                     if isinstance(value, RegistrationBatch):
-                        n = self._register(dm, value)
+                        ack = self._register(dm, value)
+                        n = sum(1 for s in ack.status if s == ACK_NEW)
                         registered.inc(n)
-                        if n < len(value):
-                            rejected.inc(len(value) - n)
+                        n_rej = sum(1 for s in ack.status if s == ACK_REJECTED)
+                        if n_rej:
+                            rejected.inc(n_rej)
+                        # compact agent protocol round trip: the binary
+                        # ack rides the device's command route (reference:
+                        # RegistrationAck down the MQTT command topic)
+                        await self._send_acks(dm, ack)
                     elif isinstance(value, dict) and "device_indices" in value:
                         unknown_idx.inc(len(value["device_indices"]))
                 consumer.commit()
         finally:
             consumer.close()
 
-    def _register(self, dm, batch: RegistrationBatch) -> int:
+    def _register(self, dm, batch: RegistrationBatch) -> RegistrationAck:
         engine = self.engine
+        tokens = list(batch.device_tokens)
+
+        def all_status(st: int) -> RegistrationAck:
+            return RegistrationAck(tokens, [st] * len(tokens),
+                                   [-1] * len(tokens))
+
         if not engine.allow_unknown:
-            return 0
+            return all_status(ACK_REJECTED)
         dt_token = batch.device_type_token or engine.default_device_type
         if not dt_token:
-            logger.warning("registration: no device type for %s",
-                           batch.device_tokens)
-            return 0
+            logger.warning("registration: no device type for %s", tokens)
+            return all_status(ACK_REJECTED)
         dt = dm.get_device_type_by_token(dt_token)
         if dt is None:
             # first sight of the default type: create it (dataset-template
@@ -96,17 +113,46 @@ class RegistrationManager(BackgroundTaskComponent):
         if batch.area_token or engine.default_area:
             area = dm.get_area_by_token(batch.area_token or engine.default_area)
             area_id = area.id if area else None
-        count = 0
-        for token in batch.device_tokens:
-            if dm.get_device_by_token(token) is not None:
-                continue  # already registered (at-least-once redelivery)
+        status, index = [], []
+        for token in tokens:
+            existing = dm.get_device_by_token(token)
+            if existing is not None:
+                # already registered (at-least-once redelivery): ack with
+                # the existing index so the device still learns its slot
+                status.append(ACK_ALREADY)
+                index.append(int(existing.index))
+                continue
             device = dm.create_device(Device(
                 token=token, device_type_id=dt.id,
                 metadata=dict(batch.metadata)))
             dm.create_device_assignment(DeviceAssignment(
                 device_id=device.id, area_id=area_id, token=f"{token}-auto"))
-            count += 1
-        return count
+            status.append(ACK_NEW)
+            index.append(int(device.index))
+        return RegistrationAck(tokens, status, index)
+
+    async def _send_acks(self, dm, ack: RegistrationAck) -> None:
+        """Per-device binary acks via command-delivery's routed provider.
+        Best-effort: no command-delivery service (or no live downlink for
+        the device) just means the device polls its index instead."""
+        runtime = self.engine.runtime
+        svc = runtime.services.get("command-delivery")
+        if svc is None:
+            return
+        delivery = svc.engines.get(self.engine.tenant_id)
+        if delivery is None:
+            return
+        for i, token in enumerate(ack.device_tokens):
+            device = dm.get_device_by_token(token)
+            if device is None:
+                continue
+            one = RegistrationAck([token], [ack.status[i]],
+                                  [ack.device_index[i]])
+            try:
+                await delivery.deliver_raw(device, one.encode())
+            except Exception:  # noqa: BLE001 - ack delivery is best-effort
+                logger.exception("registration ack delivery failed for %s",
+                                 token)
 
 
 class DeviceRegistrationService(Service):

@@ -33,7 +33,13 @@ from sitewhere_tpu.domain.batch import (
     MeasurementBatch,
     RegistrationBatch,
 )
-from sitewhere_tpu.domain.batch import MAGIC, MSG_LOCATIONS, MSG_MEASUREMENTS, _HEADER
+from sitewhere_tpu.domain.batch import (
+    MAGIC,
+    MSG_LOCATIONS,
+    MSG_MEASUREMENTS,
+    MSG_REGISTRATION,
+    _HEADER,
+)
 from sitewhere_tpu.kernel.bus import TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent, LifecycleComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
@@ -58,6 +64,8 @@ class Swb1Decoder:
             return [MeasurementBatch.decode(payload, ctx)]
         if msg_type == MSG_LOCATIONS:
             return [LocationBatch.decode(payload, ctx)]
+        if msg_type == MSG_REGISTRATION:  # compact agent protocol
+            return [RegistrationBatch.decode(payload, ctx)]
         raise ValueError(f"unknown SWB1 message type {msg_type}")
 
 
@@ -227,12 +235,18 @@ class MqttEventReceiver(BackgroundTaskComponent):
                  decoder: EventDecoder, host: str = "127.0.0.1",
                  port: int = 0, users: Optional[dict] = None,
                  command_topic_prefix: str = "swx/commands/",
-                 require_client_id_match: bool = False):
+                 require_client_id_match: bool = False,
+                 subscribe_allow: Optional[list] = None):
         super().__init__(name)
         self.engine = engine
         self.decoder = decoder
         self.users = dict(users) if users else None
         self.command_topic_prefix = command_topic_prefix
+        # broker fan-out means a subscription is an EAVESDROPPING grant:
+        # by default a device may only hear its own command topic; the
+        # operator opens telemetry/ops prefixes explicitly (e.g.
+        # subscribe_allow: ["plant/", "ops/"])
+        self.subscribe_allow = tuple(subscribe_allow or ())
         # per-device credentials mode: username must equal client_id, so
         # the client_id the own-command-topic rule trusts is the one the
         # password proved. Off by default for the gateway pattern (one
@@ -252,23 +266,19 @@ class MqttEventReceiver(BackgroundTaskComponent):
         return not self.require_client_id_match or username == client_id
 
     def _authorize_sub(self, client_id: str, topic_filter: str) -> bool:
-        prefix = self.command_topic_prefix
-        if topic_filter == f"{prefix}{client_id}":
+        if topic_filter == f"{self.command_topic_prefix}{client_id}":
             return True  # a device's own command topic
-        # any filter that could match the command space is denied: a
-        # literal command prefix, or wildcards positioned to reach it
-        # (conservative: any multi-level wildcard, or a single-level
-        # wildcard inside the prefix path)
-        if topic_filter.startswith(prefix):
-            return False
-        parts = topic_filter.split("/")
-        pparts = prefix.rstrip("/").split("/")
-        for i, s in enumerate(parts):
-            if s == "#":
-                return False  # matches everything below, incl. commands
-            if i < len(pparts) and s != "+" and s != pparts[i]:
-                return True   # diverges from the command prefix: safe
-        return len(parts) <= len(pparts)  # shorter than prefix: safe
+        # everything else is default-DENY: with broker fan-out live, any
+        # other subscription would receive peers' telemetry (or, with a
+        # wildcard, the whole command space). The operator opens
+        # specific prefixes via `subscribe_allow`; wildcards must stay
+        # inside an allowed prefix.
+        for allowed in self.subscribe_allow:
+            if topic_filter.startswith(allowed) and "#" not in allowed:
+                # '#'/'+' are fine *after* the allowed prefix; reject
+                # filters whose wildcards sit before the prefix ends
+                return True
+        return False
 
     @property
     def port(self) -> int:
@@ -278,6 +288,43 @@ class MqttEventReceiver(BackgroundTaskComponent):
                           client_id: str) -> None:
         await self.engine.process_payload(
             payload, f"{self.name}:{topic}", self.decoder,
+            ingest_monotonic=time.monotonic())
+
+    async def _do_start(self, monitor) -> None:
+        await self.listener.start()
+
+    async def _run(self) -> None:  # server runs itself
+        await asyncio.Event().wait()
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        await self.listener.stop()
+
+
+class WebSocketEventReceiver(BackgroundTaskComponent):
+    """WebSocket ingest endpoint (reference analog: the WebSocket
+    receiver): devices connect to ws://host:port/ws/<client-id> and send
+    binary SWB1 (or JSON) messages; server→client frames carry command
+    downlink via the session registry (services/websocket.py)."""
+
+    def __init__(self, name: str, engine: "EventSourcesEngine",
+                 decoder: EventDecoder, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(name)
+        self.engine = engine
+        self.decoder = decoder
+        from sitewhere_tpu.services.websocket import WebSocketListener
+
+        self.listener = WebSocketListener(self._on_message, host=host,
+                                          port=port)
+
+    @property
+    def port(self) -> int:
+        return self.listener.port
+
+    async def _on_message(self, payload: bytes, client_id: str) -> None:
+        await self.engine.process_payload(
+            payload, f"{self.name}:{client_id}", self.decoder,
             ingest_monotonic=time.monotonic())
 
     async def _do_start(self, monitor) -> None:
@@ -339,7 +386,12 @@ class EventSourcesEngine(TenantEngine):
                 command_topic_prefix=cfg.get("command_topic_prefix",
                                              "swx/commands/"),
                 require_client_id_match=cfg.get("require_client_id_match",
-                                                False))
+                                                False),
+                subscribe_allow=cfg.get("subscribe_allow"))
+        elif kind == "websocket":
+            r = WebSocketEventReceiver(name, self, decoder,
+                                       host=cfg.get("host", "127.0.0.1"),
+                                       port=cfg.get("port", 0))
         else:
             raise ValueError(f"unknown receiver kind {kind!r}")
         self.receivers.append(r)

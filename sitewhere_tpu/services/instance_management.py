@@ -83,9 +83,22 @@ class InstanceManagementService(Service):
 
     async def create_tenant(self, tenant_id: str, name: str = "",
                             sections: Optional[dict] = None,
-                            authorized_user_ids: tuple[str, ...] = ()) -> Tenant:
+                            authorized_user_ids: tuple[str, ...] = (),
+                            template: Optional[str] = None) -> Tenant:
+        """Create + spin a tenant; `template` names a dataset initializer
+        (kernel/templates.py) that contributes default config sections
+        and seeds sample data once the engines are up [SURVEY.md §3.5]."""
         if self.tenant_store.get_tenant_by_token(tenant_id) is not None:
             raise ValueError(f"tenant {tenant_id!r} exists")
+        tpl = None
+        if template:
+            from sitewhere_tpu.kernel.templates import (
+                get_template,
+                merged_sections,
+            )
+
+            tpl = get_template(template)
+            sections = merged_sections(tpl, sections)
         tenant = self.tenant_store.create_tenant(Tenant(
             token=tenant_id, name=name or tenant_id,
             auth_token=new_id(),
@@ -94,6 +107,8 @@ class InstanceManagementService(Service):
             tenant_id=tenant_id, name=tenant.name,
             authorized_user_ids=tuple(authorized_user_ids),
             sections=sections or {}))
+        if tpl is not None and tpl.seed is not None:
+            await tpl.seed(self.runtime, tenant_id)
         return tenant
 
     async def update_tenant(self, tenant_id: str,

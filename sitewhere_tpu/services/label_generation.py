@@ -73,11 +73,42 @@ class Code39LabelGenerator:
         return svg.encode()
 
 
+class QrLabelGenerator:
+    """QR symbology (reference: ZXing QR) — real ISO 18004 byte-mode
+    encoding (services/qrcode.py), verified scannable."""
+
+    def generate(self, title: str, token: str, subtitle: str = "") -> bytes:
+        from xml.sax.saxutils import escape
+
+        from sitewhere_tpu.services.qrcode import qr_matrix
+
+        M = qr_matrix(token.encode("utf-8"))
+        module, quiet = 4, 4
+        qdim = (len(M) + 2 * quiet) * module
+        path = []
+        for r, row in enumerate(M):
+            for c, v in enumerate(row):
+                if v:
+                    x, y = (c + quiet) * module, (r + quiet) * module
+                    path.append(f"M{x} {y}h{module}v{module}h-{module}z")
+        width = max(qdim + 24, 240)
+        height = qdim + 56
+        svg = f"""<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">
+<rect width="100%" height="100%" fill="white"/>
+<text x="12" y="18" font-family="monospace" font-size="14" font-weight="bold">{escape(title)}</text>
+<text x="12" y="34" font-family="monospace" font-size="10" fill="#555">{escape(subtitle)}</text>
+<g transform="translate(12,40)"><path fill="#000" d="{''.join(path)}"/></g>
+<text x="12" y="{height - 6}" font-family="monospace" font-size="10">{escape(token)}</text>
+</svg>"""
+        return svg.encode()
+
+
 class LabelGenerationEngine(TenantEngine):
     def __init__(self, service: "LabelGenerationService", tenant: TenantConfig):
         super().__init__(service, tenant)
         self.generators: dict[str, LabelGenerator] = {
-            "code39": Code39LabelGenerator()}
+            "code39": Code39LabelGenerator(),
+            "qr": QrLabelGenerator()}
         self.default_generator = tenant.section(
             "label-generation", {}).get("generator", "code39")
 

@@ -104,12 +104,17 @@ class MqttListener:
       command space)."""
 
     def __init__(self, on_publish, host: str = "127.0.0.1", port: int = 0,
-                 authenticate=None, authorize_sub=None):
+                 authenticate=None, authorize_sub=None,
+                 max_retained: int = 4096):
         self.on_publish = on_publish
         self.host, self.port = host, port
         self.authenticate = authenticate
         self.authorize_sub = authorize_sub
         self.sessions: dict[str, MqttSession] = {}
+        # retained messages (PUBLISH with retain flag): delivered to new
+        # matching subscriptions, like any broker; bounded (drop-oldest)
+        self.retained: dict[str, bytes] = {}
+        self.max_retained = max_retained
         self._conns: set[asyncio.StreamWriter] = set()
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -149,12 +154,16 @@ class MqttListener:
                 return False
         return len(sp) == len(tp)
 
-    async def publish_to_subscribers(self, topic: str, payload: bytes) -> int:
+    async def publish_to_subscribers(self, topic: str, payload: bytes,
+                                     exclude: Optional[str] = None,
+                                     retain_flag: bool = False) -> int:
         """QoS0 PUBLISH to every session subscribed to `topic`."""
         body = len(topic).to_bytes(2, "big") + topic.encode() + payload
-        pkt = _packet(PUBLISH, 0, body)
+        pkt = _packet(PUBLISH, 1 if retain_flag else 0, body)
         n = 0
         for s in list(self.sessions.values()):
+            if s.client_id == exclude:
+                continue
             if any(self.matches(sub, topic) for sub in s.subscriptions):
                 try:
                     s.writer.write(pkt)
@@ -163,6 +172,14 @@ class MqttListener:
                 except (ConnectionError, RuntimeError):
                     self.sessions.pop(s.client_id, None)
         return n
+
+    def _retain(self, topic: str, payload: bytes) -> None:
+        if not payload:  # zero-length retained PUBLISH clears (spec §3.3.1.3)
+            self.retained.pop(topic, None)
+            return
+        self.retained[topic] = payload
+        while len(self.retained) > self.max_retained:
+            self.retained.pop(next(iter(self.retained)))
 
     # -- inbound -----------------------------------------------------------
 
@@ -264,6 +281,7 @@ class MqttListener:
     async def _on_publish(self, flags: int, body: bytes,
                           session: MqttSession, writer) -> None:
         qos = (flags >> 1) & 0x3
+        retain = bool(flags & 0x1)
         topic, off = _utf8(body, 0)
         packet_id = None
         if qos > 0:
@@ -275,18 +293,33 @@ class MqttListener:
             # PUBREC now — PUBREL→PUBCOMP completes in the handler loop
             if packet_id not in session.qos2_pending:
                 session.qos2_pending.add(packet_id)
-                await self.on_publish(topic, payload, session.client_id)
+                await self._ingest_and_fan_out(topic, payload, session,
+                                               retain)
             writer.write(_packet(PUBREC, 0, packet_id.to_bytes(2, "big")))
             return
-        await self.on_publish(topic, payload, session.client_id)
+        await self._ingest_and_fan_out(topic, payload, session, retain)
         if qos == 1 and packet_id is not None:
             writer.write(_packet(PUBACK, 0, packet_id.to_bytes(2, "big")))
+
+    async def _ingest_and_fan_out(self, topic: str, payload: bytes,
+                                  session: MqttSession,
+                                  retain: bool) -> None:
+        """Every accepted PUBLISH goes two ways: into the platform
+        pipeline AND out to matching subscribed peers (real broker
+        semantics — subscription authorization already gated who may
+        listen where)."""
+        if retain:
+            self._retain(topic, payload)
+        await self.on_publish(topic, payload, session.client_id)
+        await self.publish_to_subscribers(topic, payload,
+                                          exclude=session.client_id)
 
     def _on_subscribe(self, body: bytes, session: MqttSession,
                       writer) -> None:
         packet_id = int.from_bytes(body[0:2], "big")
         off = 2
         codes = bytearray()
+        deliver_retained: list[tuple[str, bytes]] = []
         while off < len(body):
             topic_filter, off = _utf8(body, off)
             off += 1  # requested QoS; we grant QoS0
@@ -299,8 +332,16 @@ class MqttListener:
                 continue
             session.subscriptions.append(topic_filter)
             codes.append(0)
+            # retained messages matching the new filter deliver after the
+            # SUBACK (retain flag set so the client knows they're stored)
+            for topic, payload in list(self.retained.items()):
+                if self.matches(topic_filter, topic):
+                    deliver_retained.append((topic, payload))
         writer.write(_packet(SUBACK, 0, packet_id.to_bytes(2, "big")
                              + bytes(codes)))
+        for topic, payload in deliver_retained:
+            body2 = len(topic).to_bytes(2, "big") + topic.encode() + payload
+            writer.write(_packet(PUBLISH, 1, body2))
 
     def _on_unsubscribe(self, body: bytes, session: MqttSession,
                         writer) -> None:

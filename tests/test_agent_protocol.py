@@ -1,0 +1,312 @@
+"""Compact agent protocol + transport breadth tests:
+
+- SWB1 MSG_REGISTRATION / MSG_REGISTRATION_ACK codec round trips
+- THE e2e check [SURVEY.md §2.1 agent proto]: an unknown device
+  registers OVER THE WIRE (MQTT) and receives its binary ack on its own
+  command topic, then streams telemetry that scores
+- WebSocket receiver: handshake + masked frames + fragmentation +
+  ping/pong carrying SWB1 into the pipeline; command downlink over the
+  same socket
+- MQTT broker semantics: live pub/sub fan-out + retained messages
+"""
+
+import asyncio
+import base64
+import hashlib
+import os
+import struct
+
+import numpy as np
+
+from sitewhere_tpu.domain.batch import (
+    ACK_ALREADY,
+    ACK_NEW,
+    BatchContext,
+    RegistrationAck,
+    RegistrationBatch,
+)
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+from tests.test_mqtt import (
+    _pkt,
+    connect_pkt,
+    publish_pkt,
+    read_pkt,
+    subscribe_pkt,
+)
+from tests.test_pipeline import running_pipeline, wait_until
+
+
+def test_registration_codec_roundtrip():
+    ctx = BatchContext(tenant_id="t")
+    reg = RegistrationBatch(ctx, ["dev-a", "dev-b"], "pump",
+                            area_token="plant-1")
+    out = RegistrationBatch.decode(reg.encode(), ctx)
+    assert out.device_tokens == ["dev-a", "dev-b"]
+    assert out.device_type_token == "pump"
+    assert out.area_token == "plant-1"
+
+    ack = RegistrationAck(["dev-a", "dev-b"], [ACK_NEW, ACK_ALREADY],
+                          [17, -1])
+    out = RegistrationAck.decode(ack.encode())
+    assert out.device_tokens == ["dev-a", "dev-b"]
+    assert out.status == [ACK_NEW, ACK_ALREADY]
+    assert out.device_index == [17, -1]
+
+
+def test_unknown_device_registers_over_mqtt_and_gets_ack(run):
+    """E2e: CONNECT as the device token → SUBSCRIBE own command topic →
+    PUBLISH a binary registration → binary ack arrives on the command
+    topic with the assigned dense index → telemetry for that index flows
+    through the pipeline."""
+
+    async def main():
+        from sitewhere_tpu.services import (
+            CommandDeliveryService,
+            DeviceRegistrationService,
+        )
+
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"}]},
+            "rule-processing": {"model": None},
+            "command-delivery": {"provider": "mqtt", "encoder": "json"},
+            "device-registration": {"allow_unknown_devices": True,
+                                    "default_device_type": "thermo"},
+        }
+        async with running_pipeline(
+                num_devices=20, sections=sections,
+                extra_services=(CommandDeliveryService,
+                                DeviceRegistrationService)) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", receiver.port)
+            writer.write(connect_pkt("sensor-new-1"))
+            await writer.drain()
+            ptype, _, body = await read_pkt(reader)
+            assert ptype == 2 and body[1] == 0
+            writer.write(subscribe_pkt("swx/commands/sensor-new-1"))
+            await writer.drain()
+            ptype, _, _ = await read_pkt(reader)
+            assert ptype == 9
+
+            # the compact binary registration request
+            reg = RegistrationBatch(BatchContext(tenant_id="acme"),
+                                    ["sensor-new-1"], "thermo")
+            writer.write(publish_pkt("swx/register", reg.encode(), qos=1,
+                                     packet_id=5))
+            await writer.drain()
+            ptype, _, _ = await read_pkt(reader)
+            assert ptype == 4  # PUBACK
+
+            # the binary ack arrives on OUR command topic
+            ptype, _, body = await read_pkt(reader)
+            assert ptype == 3  # PUBLISH
+            tlen = int.from_bytes(body[:2], "big")
+            topic = body[2:2 + tlen].decode()
+            assert topic == "swx/commands/sensor-new-1"
+            ack = RegistrationAck.decode(body[2 + tlen:])
+            assert ack.device_tokens == ["sensor-new-1"]
+            assert ack.status == [ACK_NEW]
+            new_index = ack.device_index[0]
+            assert new_index == 20  # next dense slot after the fleet
+
+            dm = rt.api("device-management").management("acme")
+            assert dm.get_device_by_token("sensor-new-1") is not None
+
+            # redelivery is idempotent: ACK_ALREADY with the same index
+            writer.write(publish_pkt("swx/register", reg.encode(), qos=1,
+                                     packet_id=6))
+            await writer.drain()
+            ptype, _, _ = await read_pkt(reader)  # PUBACK
+            ptype, _, body = await read_pkt(reader)
+            tlen = int.from_bytes(body[:2], "big")
+            ack2 = RegistrationAck.decode(body[2 + tlen:])
+            assert ack2.status == [ACK_ALREADY]
+            assert ack2.device_index == [new_index]
+
+            # the registered device's telemetry flows end to end
+            from sitewhere_tpu.domain.batch import MeasurementBatch
+
+            batch = MeasurementBatch(
+                BatchContext(tenant_id="acme"),
+                np.asarray([new_index], np.uint32),
+                np.zeros(1, np.uint16), np.asarray([21.5], np.float32),
+                np.asarray([1000.0]))
+            writer.write(publish_pkt("swx/telemetry", batch.encode()))
+            await writer.drain()
+            em = rt.api("event-management").management("acme")
+            await wait_until(
+                lambda: em.telemetry.total_events >= 1)
+            writer.close()
+
+    run(main())
+
+
+# -- WebSocket ---------------------------------------------------------------
+
+
+def _ws_client_frame(payload: bytes, opcode: int = 0x2,
+                     fin: bool = True) -> bytes:
+    mask = os.urandom(4)
+    head = bytearray([(0x80 if fin else 0) | opcode])
+    n = len(payload)
+    if n < 126:
+        head.append(0x80 | n)
+    elif n < 65536:
+        head.append(0x80 | 126)
+        head += n.to_bytes(2, "big")
+    else:
+        head.append(0x80 | 127)
+        head += n.to_bytes(8, "big")
+    masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+    return bytes(head) + mask + masked
+
+
+async def _ws_connect(port: int, path: str):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    key = base64.b64encode(os.urandom(16)).decode()
+    writer.write((f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                  f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                  f"Sec-WebSocket-Key: {key}\r\n"
+                  f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    await writer.drain()
+    resp = await reader.readuntil(b"\r\n\r\n")
+    assert b"101" in resp.split(b"\r\n")[0]
+    expect = base64.b64encode(hashlib.sha1(
+        (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode())
+        .digest())
+    assert expect in resp
+    return reader, writer
+
+
+async def _ws_read_frame(reader):
+    b1, b2 = await reader.readexactly(2)
+    length = b2 & 0x7F
+    if length == 126:
+        length = int.from_bytes(await reader.readexactly(2), "big")
+    payload = await reader.readexactly(length) if length else b""
+    return b1 & 0x0F, payload
+
+
+def test_websocket_ingest_fragmentation_and_downlink(run):
+    async def main():
+        from sitewhere_tpu.services import CommandDeliveryService
+
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "websocket", "decoder": "swb1", "name": "websocket"}]},
+            "rule-processing": {"model": None},
+            "command-delivery": {"provider": "websocket",
+                                 "encoder": "json"},
+        }
+        async with running_pipeline(
+                num_devices=10, sections=sections,
+                extra_services=(CommandDeliveryService,)) as rt:
+            receiver = rt.api("event-sources").engine("acme") \
+                .receiver("websocket")
+            reader, writer = await _ws_connect(receiver.port, "/ws/dev-3")
+
+            sim = DeviceSimulator(SimConfig(num_devices=10), tenant_id="acme")
+            payload, _ = sim.payload(t=0.0)
+            writer.write(_ws_client_frame(payload))
+            await writer.drain()
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 10)
+
+            # fragmented message: two frames, one SWB1 payload
+            payload2, _ = sim.payload(t=60.0)
+            half = len(payload2) // 2
+            writer.write(_ws_client_frame(payload2[:half], opcode=0x2,
+                                          fin=False))
+            writer.write(_ws_client_frame(payload2[half:], opcode=0x0))
+            await writer.drain()
+            await wait_until(lambda: em.telemetry.total_events == 20)
+
+            # ping → pong
+            writer.write(_ws_client_frame(b"hb", opcode=0x9))
+            await writer.drain()
+            opcode, pong = await _ws_read_frame(reader)
+            assert opcode == 0xA and pong == b"hb"
+
+            # command downlink rides the same socket
+            from sitewhere_tpu.domain.events import DeviceCommandInvocation
+            from sitewhere_tpu.domain.model import DeviceCommand
+
+            dm = rt.api("device-management").management("acme")
+            device = dm.get_device_by_token("dev-3")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="reboot", device_type_id=dt.id, name="reboot"))
+            assignment = dm.get_active_assignments_for_device(device.id)[0]
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id)])
+            opcode, frame = await asyncio.wait_for(_ws_read_frame(reader),
+                                                   10.0)
+            assert opcode == 0x2 and b"reboot" in frame
+
+            # close handshake
+            writer.write(_ws_client_frame(struct.pack("!H", 1000),
+                                          opcode=0x8))
+            await writer.drain()
+            opcode, _ = await _ws_read_frame(reader)
+            assert opcode == 0x8
+            writer.close()
+
+    run(main())
+
+
+# -- MQTT broker fan-out ------------------------------------------------------
+
+
+def test_mqtt_fan_out_and_retained(run):
+    async def main():
+        sections = {"event-sources": {"receivers": [
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt",
+             # fan-out subscriptions are default-deny; the operator opens
+             # the ops namespace explicitly
+             "subscribe_allow": ["plant/"]}]},
+            "rule-processing": {"model": None}}
+        async with running_pipeline(num_devices=5, sections=sections) as rt:
+            receiver = rt.api("event-sources").engine("acme").receiver("mqtt")
+
+            # publisher retains a status message
+            r1, w1 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w1.write(connect_pkt("publisher"))
+            await w1.drain()
+            await read_pkt(r1)
+            w1.write(_pkt(3, 0x1, (len("plant/status")).to_bytes(2, "big")
+                          + b"plant/status" + b"all-good"))  # retain flag
+            await w1.drain()
+
+            # later subscriber gets the retained message with retain set
+            r2, w2 = await asyncio.open_connection("127.0.0.1", receiver.port)
+            w2.write(connect_pkt("observer"))
+            await w2.drain()
+            await read_pkt(r2)
+            w2.write(subscribe_pkt("plant/+"))
+            await w2.drain()
+            ptype, _, _ = await read_pkt(r2)
+            assert ptype == 9  # SUBACK first
+            ptype, flags, body = await read_pkt(r2)
+            assert ptype == 3 and flags & 0x1  # retained PUBLISH
+            tlen = int.from_bytes(body[:2], "big")
+            assert body[2:2 + tlen] == b"plant/status"
+            assert body[2 + tlen:] == b"all-good"
+
+            # live fan-out: a fresh publish reaches the subscriber,
+            # not the publisher itself
+            w1.write(_pkt(3, 0, (len("plant/floor2")).to_bytes(2, "big")
+                          + b"plant/floor2" + b"hot"))
+            await w1.drain()
+            ptype, flags, body = await read_pkt(r2)
+            assert ptype == 3 and not flags & 0x1
+            tlen = int.from_bytes(body[:2], "big")
+            assert body[2 + tlen:] == b"hot"
+            w1.close()
+            w2.close()
+
+    run(main())

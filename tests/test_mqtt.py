@@ -227,7 +227,9 @@ def test_mqtt_command_topic_isolation(run):
                      ("swx/commands/#", 0x80),       # whole command space
                      ("#", 0x80),                    # global wildcard
                      ("swx/+/dev-2", 0x80),          # wildcard into commands
-                     ("swx/telemetry/x", 0x00)]      # unrelated: open
+                     # with broker fan-out, ANY other subscription is an
+                     # eavesdropping grant → default-deny
+                     ("swx/telemetry/x", 0x80)]
             for i, (topic, expect) in enumerate(cases):
                 w.write(subscribe_pkt(topic, packet_id=20 + i))
                 await w.drain()

@@ -1,0 +1,271 @@
+"""Property tests on the kernel promises (SURVEY.md §5.2).
+
+The lifecycle state machine and the bus are the two contracts everything
+else leans on; example-based tests pin happy paths, these pin the
+INVARIANTS under arbitrary operation sequences (hypothesis):
+
+- lifecycle: illegal transitions raise and leave state untouched; no
+  component is ever left in a transitional (*-ING) state at rest; stop
+  stops every descendant; a component survives any op sequence and can
+  always be recovered to STARTED; concurrent start/stop interleavings
+  never wedge the component.
+- bus: per-key ordering holds across consumer-group rebalances;
+  committed offsets are monotonic, including under retention trim; a
+  trim past the committed offset resets forward (never backward) and
+  consumption covers everything still retained (at-least-once).
+"""
+
+import asyncio
+
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from sitewhere_tpu.kernel.bus import EventBus
+from sitewhere_tpu.kernel.lifecycle import (
+    LifecycleComponent,
+    LifecycleException,
+    LifecycleStatus,
+)
+
+RESTING = {LifecycleStatus.STOPPED, LifecycleStatus.INITIALIZED,
+           LifecycleStatus.STARTED, LifecycleStatus.PAUSED,
+           LifecycleStatus.TERMINATED,
+           LifecycleStatus.INITIALIZATION_ERROR,
+           LifecycleStatus.LIFECYCLE_ERROR}
+
+
+class _Probe(LifecycleComponent):
+    """Component that yields control inside transitions (so concurrent
+    interleavings actually interleave) and counts hook invocations."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.calls = {"init": 0, "start": 0, "stop": 0}
+
+    async def _do_initialize(self, monitor) -> None:
+        self.calls["init"] += 1
+        await asyncio.sleep(0)
+
+    async def _do_start(self, monitor) -> None:
+        self.calls["start"] += 1
+        await asyncio.sleep(0)
+
+    async def _do_stop(self, monitor) -> None:
+        self.calls["stop"] += 1
+        await asyncio.sleep(0)
+
+
+def _tree() -> tuple[_Probe, list[_Probe]]:
+    root = _Probe("root")
+    kids = [_Probe(f"kid{i}") for i in range(3)]
+    for k in kids:
+        root.add_child(k)
+    grand = _Probe("grandkid")
+    kids[1].add_child(grand)
+    return root, kids + [grand]
+
+
+OPS = ("initialize", "start", "stop", "restart", "terminate")
+
+
+@settings(max_examples=60, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(ops=st.lists(st.sampled_from(OPS), min_size=1, max_size=12))
+def test_lifecycle_any_sequence_keeps_invariants(ops):
+    async def main():
+        root, descendants = _tree()
+        for op in ops:
+            before = root.status
+            try:
+                await getattr(root, op)()
+            except LifecycleException:
+                # an illegal transition must not have moved the state
+                assert root.status == before, (op, before, root.status)
+            # never at rest in a transitional state
+            assert root.status in RESTING, (op, root.status)
+            for d in descendants:
+                assert d.status in RESTING, (op, d.status)
+            if root.status == LifecycleStatus.STARTED:
+                assert all(d.status == LifecycleStatus.STARTED
+                           for d in descendants)
+            if root.status == LifecycleStatus.STOPPED and "stop" == op:
+                assert all(d.status in (LifecycleStatus.STOPPED,
+                                        LifecycleStatus.INITIALIZED,
+                                        LifecycleStatus.TERMINATED)
+                           for d in descendants)
+        # recovery invariant: unless terminated, the component can always
+        # be brought to STARTED
+        if root.status != LifecycleStatus.TERMINATED:
+            if root.status in (LifecycleStatus.STARTED,
+                               LifecycleStatus.PAUSED,
+                               LifecycleStatus.STARTING):
+                await root.stop()
+            await root.start()
+            assert root.status == LifecycleStatus.STARTED
+
+    asyncio.run(main())
+
+
+@settings(max_examples=40, deadline=None)
+@given(first=st.sampled_from(["start", "stop"]),
+       start_state=st.sampled_from(["initialized", "started"]))
+def test_lifecycle_concurrent_start_stop_never_wedges(first, start_state):
+    """Concurrent start()/stop() — the respin-during-update interleaving
+    — may raise LifecycleException in one task, but must leave the tree
+    recoverable and never resting in a transitional state."""
+
+    async def main():
+        root, descendants = _tree()
+        await root.initialize()
+        if start_state == "started":
+            await root.start()
+        a = root.start() if first == "start" else root.stop()
+        b = root.stop() if first == "start" else root.start()
+        results = await asyncio.gather(a, b, return_exceptions=True)
+        for r in results:
+            assert r is None or isinstance(r, LifecycleException), r
+        assert root.status in RESTING
+        # recoverable regardless of who won the race
+        if root.status in (LifecycleStatus.STARTED, LifecycleStatus.PAUSED):
+            await root.stop()
+        await root.start()
+        assert root.status == LifecycleStatus.STARTED
+        assert all(d.status == LifecycleStatus.STARTED for d in descendants)
+        await root.stop()
+
+    asyncio.run(main())
+
+
+# -- bus invariants ----------------------------------------------------------
+
+
+@settings(max_examples=30, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(data=st.data())
+def test_bus_per_key_order_survives_rebalances(data):
+    """Random interleaving of produces and consumer joins/leaves: each
+    key's records are observed in sequence order by whoever owns its
+    partition (duplicates allowed — at-least-once), with no reordering
+    and no loss."""
+
+    keys = ["alpha", "beta", "gamma", "delta"]
+    script = data.draw(st.lists(
+        st.one_of(
+            st.tuples(st.just("produce"), st.sampled_from(keys)),
+            st.just(("join",)),
+            st.just(("leave",)),
+        ), min_size=8, max_size=40))
+
+    async def main():
+        bus = EventBus(default_partitions=4)
+        seq = {k: 0 for k in keys}
+        consumers = []
+        seen: dict[str, list[int]] = {k: [] for k in keys}
+
+        async def drain(c):
+            for r in await c.poll(max_records=512, timeout=0.05):
+                seen[r.key].append(r.value)
+            c.commit()
+
+        consumers.append(bus.subscribe("t", group="g"))
+        for op in script:
+            if op[0] == "produce":
+                k = op[1]
+                await bus.produce("t", seq[k], key=k)
+                seq[k] += 1
+            elif op[0] == "join" and len(consumers) < 4:
+                consumers.append(bus.subscribe("t", group="g"))
+            elif op[0] == "leave" and len(consumers) > 1:
+                # drain before leaving so nothing is lost uncommitted
+                c = consumers.pop()
+                await drain(c)
+                c.close()
+            for c in consumers:
+                await drain(c)
+        for _ in range(3):
+            for c in consumers:
+                await drain(c)
+        for c in consumers:
+            c.close()
+        for k in keys:
+            got = seen[k]
+            # per-key order: non-decreasing with no skips between
+            # consecutive NEW values (dups from redelivery are legal)
+            dedup = []
+            for v in got:
+                if not dedup or v > dedup[-1]:
+                    dedup.append(v)
+                else:
+                    assert v <= dedup[-1]  # a redelivery, never the future
+            assert dedup == list(range(seq[k])), (k, got)
+
+    asyncio.run(main())
+
+
+@settings(max_examples=30, deadline=None)
+@given(batches=st.lists(st.integers(min_value=1, max_value=30),
+                        min_size=1, max_size=8),
+       retention=st.integers(min_value=4, max_value=16))
+def test_bus_commit_monotonic_under_trim(batches, retention):
+    """Produce in bursts against a tiny retention window, polling and
+    committing between bursts: the committed offset never decreases, a
+    consumer reset lands AT the trimmed base (never before), and every
+    record still retained at poll time is delivered."""
+
+    async def main():
+        bus = EventBus(default_partitions=1, retention=retention)
+        c = bus.subscribe("t", group="g")
+        group = bus._groups["g"]
+        produced = 0
+        last_commit = 0
+        for burst in batches:
+            for _ in range(burst):
+                await bus.produce("t", produced, key="k")
+                produced += 1
+            got = await c.poll(max_records=512, timeout=0.05)
+            log = bus._topics["t"].partitions[0]
+            if got:
+                # delivery resumes at max(position, trimmed base)
+                assert got[0].value >= last_commit
+                assert got[0].offset >= log.base_offset - len(got) \
+                    or got[0].offset >= 0
+                # contiguous within the poll
+                values = [r.value for r in got]
+                assert values == list(range(values[0],
+                                            values[0] + len(values)))
+                # everything still retained was delivered up to the end
+                assert got[-1].offset == log.end_offset - 1
+            c.commit()
+            committed = group.committed.get(("t", 0), 0)
+            assert committed >= last_commit  # monotone, even after trim
+            last_commit = committed
+        c.close()
+
+    asyncio.run(main())
+
+
+def test_tenant_respin_during_update_lands_on_last_config(run):
+    """Back-to-back tenant updates (the respin-during-update
+    interleaving): the surviving engine is STARTED and built from the
+    LAST config."""
+
+    async def main():
+        from sitewhere_tpu.config import InstanceSettings, TenantConfig
+        from sitewhere_tpu.kernel.service import ServiceRuntime
+        from sitewhere_tpu.services import DeviceManagementService
+
+        rt = ServiceRuntime(InstanceSettings(instance_id="respin"))
+        rt.add_service(DeviceManagementService(rt))
+        await rt.start()
+        await rt.add_tenant(TenantConfig(tenant_id="acme"))
+        cfgs = [TenantConfig(tenant_id="acme",
+                             sections={"device-management": {"rev": i}})
+                for i in range(1, 6)]
+        await asyncio.gather(*(rt.update_tenant(c) for c in cfgs))
+        # whichever update raced last through the broadcast, the engine
+        # at rest is STARTED and equivalent to the runtime's view
+        eng = rt.services["device-management"].engines["acme"]
+        assert eng.status == LifecycleStatus.STARTED
+        assert eng.tenant.equivalent(rt.tenants["acme"])
+        await rt.stop()
+
+    run(main())

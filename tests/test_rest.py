@@ -338,3 +338,156 @@ def test_rest_trace_endpoints(run):
                 "event-sources.decode"
 
     run(main())
+
+
+def test_rest_device_groups_crud_and_expand(run):
+    """VERDICT gap: /api/devicegroups CRUD + elements + recursive
+    expansion over the REST surface."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            token = body["token"]
+            await http(port, "POST", "/api/tenants", token=token,
+                       body={"token": "acme",
+                             "sections": {"rule-processing": {"model": None}}})
+            dm = rt.api("device-management").management("acme")
+            from sitewhere_tpu.domain.model import DeviceType
+
+            dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), 5)
+
+            st, g = await http(port, "POST", "/api/devicegroups",
+                               token=token, tenant="acme",
+                               body={"token": "floor-1", "name": "Floor 1",
+                                     "roles": ["monitoring"]})
+            assert st == 200 and g["token"] == "floor-1"
+            st, nested = await http(port, "POST", "/api/devicegroups",
+                                    token=token, tenant="acme",
+                                    body={"token": "rack-a"})
+            assert st == 200
+            st, els = await http(
+                port, "POST", "/api/devicegroups/rack-a/elements",
+                token=token, tenant="acme",
+                body={"elements": [{"device": "dev-0"},
+                                   {"device": "dev-1"}]})
+            assert st == 200 and len(els) == 2
+            st, els = await http(
+                port, "POST", "/api/devicegroups/floor-1/elements",
+                token=token, tenant="acme",
+                body={"elements": [{"device": "dev-4"},
+                                   {"group": "rack-a"}]})
+            assert st == 200
+            # recursive expansion: dev-4 + rack-a's two devices
+            st, devices = await http(port, "GET",
+                                     "/api/devicegroups/floor-1/devices",
+                                     token=token, tenant="acme")
+            assert st == 200
+            assert sorted(d["token"] for d in devices) == \
+                ["dev-0", "dev-1", "dev-4"]
+            st, groups = await http(port, "GET", "/api/devicegroups",
+                                    token=token, tenant="acme")
+            assert st == 200 and len(groups) == 2
+            st, _ = await http(port, "DELETE", "/api/devicegroups/rack-a",
+                               token=token, tenant="acme")
+            assert st == 200
+            st, _ = await http(port, "GET", "/api/devicegroups/rack-a",
+                               token=token, tenant="acme")
+            assert st == 404
+            # unknown element refs are 400, not 500
+            st, _ = await http(
+                port, "POST", "/api/devicegroups/floor-1/elements",
+                token=token, tenant="acme",
+                body={"elements": [{"device": "nope"}]})
+            assert st == 400
+
+    run(main())
+
+
+def test_rest_qr_label_scannable(run):
+    """VERDICT gap: QR symbology beside Code 39 — and the symbol must
+    ACTUALLY scan (decoded with OpenCV's QR reader)."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            token = body["token"]
+            await http(port, "POST", "/api/tenants", token=token,
+                       body={"token": "acme",
+                             "sections": {"rule-processing": {"model": None}}})
+            from sitewhere_tpu.domain.model import DeviceType
+
+            dm = rt.api("device-management").management("acme")
+            dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), 3)
+            st, headers, svg = await http(
+                port, "GET", "/api/labels/devices/dev-2?generator=qr",
+                token=token, tenant="acme", raw=True)
+            assert st == 200
+            assert headers["content-type"] == "image/svg+xml"
+            assert b"<svg" in svg and b"path" in svg
+
+            import cv2
+            import numpy as np
+
+            from sitewhere_tpu.services.qrcode import qr_matrix
+
+            M = np.array(qr_matrix(b"dev-2"), dtype=np.uint8)
+            img = (np.pad(1 - M, 4, constant_values=1) * 255).astype(np.uint8)
+            img = np.kron(img, np.ones((8, 8), np.uint8)).astype(np.uint8)
+            data, _, _ = cv2.QRCodeDetector().detectAndDecode(img)
+            assert data == "dev-2"
+
+    run(main())
+
+
+def test_rest_templated_tenant_scores_without_bootstrap(run):
+    """VERDICT gap: POST /api/tenants {template: "demo"} seeds
+    types/fleet/group/scripts — the tenant scores simulator events with
+    NO manual bootstrap."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            token = body["token"]
+            st, t = await http(port, "POST", "/api/tenants", token=token,
+                               body={"token": "acme", "template": "demo"})
+            assert st == 200 and t["token"] == "acme"
+            dm = rt.api("device-management").management("acme")
+            assert dm.get_device_type_by_token("thermo") is not None
+            assert dm.get_device_by_token("dev-99") is not None  # 100 fleet
+            group = dm.get_device_group_by_token("demo-floor-1")
+            assert group is not None
+            assert len(dm.expand_group_devices(group.id)) == 10
+            am = rt.api("asset-management").management("acme")
+            assert am.get_asset_by_token("hvac-1") is not None
+            rp = rt.api("rule-processing").engine("acme")
+            assert "script:high-temp-note" in rp.hooks
+            assert rp.session is not None  # streaming scorer configured
+
+            # unknown template is a clean 409/400-class error
+            st, err = await http(port, "POST", "/api/tenants", token=token,
+                                 body={"token": "b", "template": "nope"})
+            assert st == 409
+
+            # the templated tenant scores events end to end
+            from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+            session = rp.session
+            t0 = asyncio.get_event_loop().time()
+            while not session.ready:
+                await asyncio.sleep(0.1)
+                assert asyncio.get_event_loop().time() - t0 < 120
+            receiver = rt.api("event-sources").engine("acme") \
+                .receiver("default")
+            sim = DeviceSimulator(SimConfig(num_devices=100), tenant_id="acme")
+            for k in range(3):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 300)
+            snap = rt.metrics.snapshot()
+            await wait_until(lambda: rt.metrics.snapshot()
+                             ["scoring.e2e_latency_s"]["count"] >= 300)
+
+    run(main())
