@@ -54,6 +54,16 @@ def tenant_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     return NamedSharding(mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
 
 
+def tenant_placer(mesh: Optional[Mesh]):
+    """`place(leaf)` for tenant-stacked state: device_put with the
+    leading (tenant) axis sharded over `model`, or plain device_put when
+    there is no mesh. Shared by the stacked rings (scoring/ring.py,
+    scoring/stream.py) so their placement can't diverge."""
+    if mesh is None:
+        return jax.device_put
+    return lambda leaf: jax.device_put(leaf, tenant_sharding(mesh, leaf.ndim))
+
+
 def shard_batch(mesh: Mesh, *arrays: jax.Array | np.ndarray):
     """Pad each array's leading dim to a multiple of the data axis and
     place it sharded. Returns (arrays..., original_n)."""

@@ -73,16 +73,12 @@ class TenantStack:
     def _data_ax(self) -> int:
         return self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
 
-    def _param_sharding(self, leaf):
-        if self.mesh is None:
-            return None
-        return NamedSharding(self.mesh, P(MODEL_AXIS, *([None] * (leaf.ndim - 1))))
-
     def _place_stack(self, stacked):
-        if self.mesh is None:
-            return jax.device_put(stacked)
-        return jax.tree.map(
-            lambda leaf: jax.device_put(leaf, self._param_sharding(leaf)), stacked)
+        # same tenant-axis placement as the stacked rings (scoring/ring.py,
+        # scoring/stream.py): params and ring state must co-shard
+        from sitewhere_tpu.parallel.mesh import tenant_placer
+
+        return jax.tree.map(tenant_placer(self.mesh), stacked)
 
     def _batch_sharding(self, ndim: int):
         if self.mesh is None:

@@ -140,13 +140,23 @@ class TenantSlot:
         self.pool.admit(self.tenant_id, batch)
 
     def swap_params(self, params: dict) -> int:
-        return self.pool.stack.set_params(self.tenant_id, params)
+        version = self.pool.stack.set_params(self.tenant_id, params)
+        if self.pool.streaming:
+            # streaming state (h/c/pred) is a function of the weights —
+            # reseed this tenant's rows from its host history, same as
+            # ScoringSession.swap_params (reusing the params in hand, not
+            # a device→host gather of the slice just written)
+            self.pool._seed_tenant_ring(
+                self.tenant_id, self.pool.stack.slots[self.tenant_id],
+                self.pool.tenants[self.tenant_id].telemetry, params=params)
+        return version
 
     def reload_history(self) -> None:
         """Re-seed this tenant's ring slice from its host store (bulk
         imports that bypassed admit) — mirrors ScoringSession's."""
         entry = self.pool.tenants[self.tenant_id]
-        self.pool._seed_tenant_ring(self.pool.stack.slots[self.tenant_id],
+        self.pool._seed_tenant_ring(self.tenant_id,
+                                    self.pool.stack.slots[self.tenant_id],
                                     entry.telemetry)
 
 
@@ -200,25 +210,51 @@ class SharedScoringPool:
         host = telemetry.channels.get(self.cfg.mtype)
         host_cap = host.capacity if host is not None else 1024
         if self.ring is None:
-            self.ring = StackedDeviceRing(
-                self.model.cfg.window, self.stack.capacity,
-                device_cap=host_cap, mesh=self.mesh)
+            self.ring = self._new_ring(host_cap)
         else:
             self.ring.ensure(self.stack.capacity, host_cap - 1)
             self.ring.clear_tenant(slot)  # a reused slot must not leak history
-        self._seed_tenant_ring(slot, telemetry)
+        self._seed_tenant_ring(tenant_id, slot, telemetry, params=params)
         self._ensure_started()
         if self._current_key() != self._warmed_key:
             self._start_warmup()
         return TenantSlot(self, tenant_id)
 
-    def _seed_tenant_ring(self, slot: int, telemetry: TelemetryStore) -> None:
+    @property
+    def streaming(self) -> bool:
+        return bool(getattr(self.model, "streaming", False))
+
+    def _new_ring(self, device_cap: int):
+        """Stacked window ring (per-event W-step rescan) or stacked
+        streaming ring (one model step per event) — the model declares
+        which hot path it wants, exactly like the dedicated session."""
+        if self.streaming:
+            from sitewhere_tpu.scoring.stream import StackedStreamingRing
+
+            return StackedStreamingRing(
+                self.model, self.stack.capacity, device_cap=device_cap,
+                mesh=self.mesh)
+        return StackedDeviceRing(
+            self.model.cfg.window, self.stack.capacity,
+            device_cap=device_cap, mesh=self.mesh)
+
+    def _seed_tenant_ring(self, tenant_id: str, slot: int,
+                          telemetry: TelemetryStore,
+                          params: Optional[dict] = None) -> None:
         host = telemetry.channels.get(self.cfg.mtype)
         if host is None:
             return
         w = self.model.cfg.window
         x, _ = host.window(np.arange(host.capacity), w)
-        self.ring.load_tenant(slot, x, np.minimum(host.count, w))
+        cnt = np.minimum(host.count, w)
+        if self.streaming:
+            # streaming state is a function of this tenant's WEIGHTS —
+            # seed by replaying its host windows under its params slice
+            if params is None:
+                params = self.stack.get_params(tenant_id)
+            self.ring.load_tenant(slot, x, cnt, params)
+        else:
+            self.ring.load_tenant(slot, x, cnt)
 
     def unregister(self, tenant_id: str) -> None:
         entry = self.tenants.pop(tenant_id, None)
@@ -507,13 +543,12 @@ class SharedScoringPool:
                     e.inflight = max(0, e.inflight - 1)
 
     def _recover_ring(self, restart_warmup: bool = True) -> None:
-        self.ring = StackedDeviceRing(
-            self.model.cfg.window, self.stack.capacity,
-            device_cap=self.ring.device_cap if self.ring else 1024,
-            mesh=self.mesh)
+        self.ring = self._new_ring(
+            self.ring.device_cap if self.ring else 1024)
         for tid, entry in self.tenants.items():
             try:
-                self._seed_tenant_ring(self.stack.slots[tid], entry.telemetry)
+                self._seed_tenant_ring(tid, self.stack.slots[tid],
+                                       entry.telemetry)
             except Exception:  # noqa: BLE001 - empty ring still scores
                 logger.exception("ring reseed failed for tenant %s", tid)
         if restart_warmup:

@@ -158,25 +158,16 @@ class StackedDeviceRing:
 
     def __init__(self, window: int, n_tenants: int, device_cap: int = 1024,
                  mesh=None):
+        from sitewhere_tpu.parallel.mesh import tenant_placer
+
         self.window = int(window)
         self.mesh = mesh
         self.t_cap = int(n_tenants)
         self.device_cap = grow_pow2(int(device_cap), floor=1024)
         self._fns: dict[tuple, Callable] = {}
         self.faulted = False
+        self._place = tenant_placer(mesh)
         self._alloc()
-
-    def _state_sharding(self, ndim: int):
-        if self.mesh is None:
-            return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from sitewhere_tpu.parallel.mesh import MODEL_AXIS
-        return NamedSharding(self.mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
-
-    def _place(self, leaf):
-        sh = self._state_sharding(leaf.ndim)
-        return jax.device_put(leaf, sh) if sh is not None else jax.device_put(leaf)
 
     def _alloc(self) -> None:
         t, d, w = self.t_cap, self.device_cap, self.window

@@ -34,6 +34,23 @@ import numpy as np
 from sitewhere_tpu.utils import grow_pow2
 
 
+def streaming_step(model) -> Callable:
+    """The fused gather→step_score→scatter step body, shared by the
+    dedicated ring (jit) and the stacked ring (jit∘vmap) so the two hot
+    paths cannot diverge."""
+
+    def step(params, state, dev, v):
+        rows = jax.tree.map(lambda leaf: leaf[dev], state)
+        scores, new_rows = model.step_score(params, rows, v)
+
+        def scatter(leaf, rows_new):
+            return leaf.at[dev].set(rows_new, mode="drop")
+
+        return jax.tree.map(scatter, state, new_rows), scores
+
+    return step
+
+
 class StreamingRing:
     """Per-device streaming model state for up to `capacity` devices,
     plus one scratch row (index `capacity`) that absorbs padding."""
@@ -92,18 +109,7 @@ class StreamingRing:
     # -- compiled step -----------------------------------------------------
 
     def _build_step(self, cap: int, bucket: int) -> Callable:
-        model = self.model
-
-        def step(params, state, dev, v):
-            rows = jax.tree.map(lambda leaf: leaf[dev], state)
-            scores, new_rows = model.step_score(params, rows, v)
-
-            def scatter(leaf, rows_new):
-                return leaf.at[dev].set(rows_new, mode="drop")
-
-            return jax.tree.map(scatter, state, new_rows), scores
-
-        return jax.jit(step, donate_argnums=(1,))
+        return jax.jit(streaming_step(self.model), donate_argnums=(1,))
 
     def _pad(self, dev: np.ndarray, v: np.ndarray,
              bucket: int) -> tuple[np.ndarray, np.ndarray]:
@@ -128,6 +134,138 @@ class StreamingRing:
             self.state, scores = fn(params, self.state, pdev, pv)
         except Exception:
             self.faulted = True  # donated state is gone; needs load()
+            raise
+        return scores
+
+    def close(self) -> None:
+        self._fns.clear()
+
+
+class StackedStreamingRing:
+    """Per-tenant streaming model state stacked on a leading tenant axis
+    — the pooled (config 4) twin of `StreamingRing`, and the streaming
+    twin of `ring.StackedDeviceRing`.
+
+    State leaves are `[T_cap, D_cap+1, ...]`; with a mesh the tenant
+    axis is sharded over `model` (matching the stacked params in
+    parallel/tenant_stack.py), so each device holds its tenants' model
+    state resident. One flush is ONE jitted
+
+        vmap(gather rows → model.step_score → scatter back)
+
+    over the tenant axis, donated in place: every tenant's events cost
+    one cell step each (not a W-step window rescan), uploading only the
+    `[T_cap, B]` (device id, value) deltas. Padding lands in each
+    tenant's scratch row `D_cap`.
+
+    Seeding is per-tenant (`load_tenant`) because streaming state is a
+    function of that tenant's WEIGHTS — the caller passes the tenant's
+    unstacked params and the state is rebuilt by `model.warm_state`
+    replay of its host windows (same recovery story as the other rings).
+    """
+
+    def __init__(self, model, n_tenants: int, device_cap: int = 1024,
+                 mesh=None):
+        from sitewhere_tpu.parallel.mesh import tenant_placer
+
+        self.model = model
+        self.window = int(model.cfg.window)
+        self.mesh = mesh
+        self.t_cap = int(n_tenants)
+        self.device_cap = grow_pow2(int(device_cap), floor=1024)
+        self._fns: dict[tuple, Callable] = {}
+        self.faulted = False
+        self._place = tenant_placer(mesh)
+        self.state = self._alloc(self.t_cap, self.device_cap)
+
+    def _alloc(self, t: int, d: int):
+        single = self.model.init_state(d + 1)  # leaves [d+1, ...]
+        return jax.tree.map(
+            lambda leaf: self._place(
+                jnp.tile(leaf[None], (t,) + (1,) * leaf.ndim)),
+            single)
+
+    # -- capacity ----------------------------------------------------------
+
+    def ensure(self, n_tenants: int, max_device: int) -> None:
+        """Grow either axis (device-side). The tenant axis adopts
+        `n_tenants` exactly — it must equal the param stack's capacity
+        (vmap needs matching leading dims)."""
+        new_t = max(self.t_cap, n_tenants)
+        new_d = self.device_cap
+        if max_device >= new_d:
+            new_d = grow_pow2(max_device + 1, floor=new_d * 2)
+        if new_t == self.t_cap and new_d == self.device_cap:
+            return
+        if new_d != self.device_cap:
+            # drop the old scratch row, append fresh rows + a fresh
+            # scratch per tenant (fresh rows are weight-independent
+            # zeros; real devices landing there get warm-seeded or
+            # simply accumulate state from their next events)
+            fresh = self.model.init_state(new_d - self.device_cap + 1)
+
+            def extend_d(leaf, pad):
+                pad_t = jnp.tile(pad[None], (self.t_cap,) + (1,) * pad.ndim)
+                return jnp.concatenate([leaf[:, :-1], pad_t], axis=1)
+
+            self.state = jax.tree.map(extend_d, self.state, fresh)
+        if new_t != self.t_cap:
+            grown = self._alloc(new_t - self.t_cap, new_d)
+            self.state = jax.tree.map(
+                lambda leaf, pad: jnp.concatenate([leaf, pad], axis=0),
+                self.state, grown)
+        self.state = jax.tree.map(self._place, self.state)
+        self.t_cap, self.device_cap = new_t, new_d
+
+    # -- seeding -----------------------------------------------------------
+
+    def load_tenant(self, slot: int, values: np.ndarray, count: np.ndarray,
+                    params: dict) -> None:
+        """Seed one tenant's state rows by replaying its host windows
+        (`TelemetryStore.window` layout) under ITS params."""
+        n, w = values.shape
+        assert w == self.window
+        self.ensure(slot + 1, n - 1 if n else 0)
+        if n == 0:
+            self.faulted = False
+            return
+        valid = np.arange(w)[None, :] >= (w - np.minimum(count, w))[:, None]
+        seeded = self.model.warm_state(
+            params, jnp.asarray(values, jnp.float32), jnp.asarray(valid))
+
+        def put(leaf, rows):
+            return self._place(leaf.at[slot, :n].set(rows))
+
+        self.state = jax.tree.map(put, self.state, seeded)
+        self.faulted = False
+
+    def clear_tenant(self, slot: int) -> None:
+        """Reset a departed tenant's rows (slot reuse must not leak)."""
+        fresh = self.model.init_state(self.device_cap + 1)
+        self.state = jax.tree.map(
+            lambda leaf, f: self._place(leaf.at[slot].set(f)),
+            self.state, fresh)
+
+    # -- compiled step -----------------------------------------------------
+
+    def _build_step(self) -> Callable:
+        return jax.jit(jax.vmap(streaming_step(self.model)),
+                       donate_argnums=(1,))
+
+    def update_and_score(self, model, stacked_params, dev: np.ndarray,
+                         v: np.ndarray) -> jax.Array:
+        """dev: [T_cap, B] int32 (scratch-row-padded, unique ids per
+        tenant row!), v: [T_cap, B] float32 → [T_cap, B] scores on
+        device (async)."""
+        key = ("ss", self.t_cap, self.device_cap, dev.shape[1])
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = self._build_step()
+        try:
+            self.state, scores = fn(stacked_params, self.state,
+                                    jnp.asarray(dev), jnp.asarray(v))
+        except Exception:
+            self.faulted = True  # donated state is gone; needs reseeding
             raise
         return scores
 

@@ -12,10 +12,12 @@ import numpy as np
 from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.models import build_model
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool
 from sitewhere_tpu.scoring.server import ScoringConfig, ScoringSession
-from sitewhere_tpu.scoring.stream import StreamingRing
+from sitewhere_tpu.scoring.stream import StackedStreamingRing, StreamingRing
 from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
 
+from tests.test_pipeline import wait_until
 from tests.test_scoring import _fill_store
 
 
@@ -166,5 +168,145 @@ def test_streaming_swap_params_reseeds_state(run):
         assert s.version == 1
         s.close()
         fresh.close()
+
+    run(main())
+
+
+# -- pooled streaming (config 4 at streaming speed) -------------------------
+
+
+def _make_pool_tenant(pool, tid, n_devices, seed, delivered, params=None,
+                      threshold=4.0, ticks=70):
+    store = TelemetryStore(history=128, initial_devices=n_devices)
+    sim = DeviceSimulator(SimConfig(num_devices=n_devices, seed=seed),
+                          tenant_id=tid)
+    _fill_store(store, sim, ticks)
+    delivered[tid] = []
+
+    async def deliver(scored, tid=tid):
+        delivered[tid].append(scored)
+
+    slot = pool.register(tid, store, threshold, deliver, params=params)
+    return store, sim, slot
+
+
+def test_pool_streaming_uses_stacked_streaming_ring(run):
+    """A streaming model in the shared pool gets the streaming stacked
+    ring (one cell step per event), not the windowed W-step rescan."""
+
+    async def main():
+        model = build_model("lstm-stream", window=64)
+        pool = SharedScoringPool(model, MetricsRegistry(),
+                                 PoolConfig(batch_buckets=(64,),
+                                            batch_window_ms=1.0))
+        delivered: dict[str, list] = {}
+        _make_pool_tenant(pool, "a", 20, 3, delivered)
+        assert isinstance(pool.ring, StackedStreamingRing)
+        await wait_until(lambda: pool.ready, timeout=60.0)
+        assert np.asarray(pool.ring.state["count"])[0, :20].min() >= 8
+        pool.close()
+
+    run(main())
+
+
+def test_pool_streaming_matches_dedicated_sessions(run):
+    """Parity: N tenants scored through the shared streaming pool get
+    the SAME scores as each tenant alone in a dedicated streaming
+    session — same weights, same events, same seeding path."""
+
+    async def main():
+        import jax
+
+        model = build_model("lstm-stream", window=64)
+        params = {tid: model.init(jax.random.PRNGKey(i + 10))
+                  for i, tid in enumerate(("a", "b"))}
+        pool = SharedScoringPool(model, MetricsRegistry(),
+                                 PoolConfig(batch_buckets=(64,),
+                                            batch_window_ms=1.0))
+        delivered: dict[str, list] = {}
+        stores, sims = {}, {}
+        for i, tid in enumerate(("a", "b")):
+            stores[tid], sims[tid], _ = _make_pool_tenant(
+                pool, tid, 30, i + 20, delivered, params=params[tid])
+        await wait_until(lambda: pool.ready, timeout=60.0)
+
+        # dedicated reference sessions share the host stores (already
+        # seeded) and the exact params
+        refs = {}
+        for tid in ("a", "b"):
+            refs[tid] = ScoringSession(
+                build_model("lstm-stream", window=64), stores[tid],
+                MetricsRegistry(), ScoringConfig(buckets=(64,)),
+                params=params[tid])
+            refs[tid].warmup()
+
+        for k in range(3):
+            expect = {}
+            for tid in ("a", "b"):
+                batch, _ = sims[tid].tick(t=(70 + k) * 60.0)
+                stores[tid].append_measurements(batch)
+                pool.admit(tid, batch)
+                refs[tid].admit(batch)
+                expect[tid] = await refs[tid].flush()
+            await wait_until(
+                lambda k=k: all(len(delivered[t]) == k + 1
+                                for t in ("a", "b")), timeout=30.0)
+            for tid in ("a", "b"):
+                got = delivered[tid][k]
+                order = np.argsort(got.device_index)
+                ref_order = np.argsort(expect[tid].device_index)
+                np.testing.assert_allclose(
+                    got.score[order], expect[tid].score[ref_order],
+                    atol=1e-4)
+        for r in refs.values():
+            r.close()
+        pool.close()
+
+    run(main())
+
+
+def test_pool_streaming_swap_params_reseeds_slot(run):
+    """Checkpoint rollout on ONE pooled tenant reseeds only that
+    tenant's streaming state under the new weights; neighbors keep
+    their state untouched."""
+
+    async def main():
+        import jax
+
+        model = build_model("lstm-stream", window=64)
+        pool = SharedScoringPool(model, MetricsRegistry(),
+                                 PoolConfig(batch_buckets=(64,),
+                                            batch_window_ms=1.0))
+        delivered: dict[str, list] = {}
+        stores, slots = {}, {}
+        for i, tid in enumerate(("a", "b")):
+            stores[tid], _, slots[tid] = _make_pool_tenant(
+                pool, tid, 25, i + 30, delivered)
+        await wait_until(lambda: pool.ready, timeout=60.0)
+        slot_a = pool.stack.slots["a"]
+        slot_b = pool.stack.slots["b"]
+        pred_a0 = np.asarray(pool.ring.state["pred"][slot_a, :25]).copy()
+        pred_b0 = np.asarray(pool.ring.state["pred"][slot_b, :25]).copy()
+
+        new_params = model.init(jax.random.PRNGKey(99))
+        version = slots["a"].swap_params(new_params)
+        assert version == 1
+        # a's state moved to the new weights...
+        pred_a1 = np.asarray(pool.ring.state["pred"][slot_a, :25])
+        assert np.abs(pred_a1 - pred_a0).max() > 1e-3
+        # ...and matches a dedicated session born with them
+        ref = ScoringSession(
+            build_model("lstm-stream", window=64), stores["a"],
+            MetricsRegistry(), ScoringConfig(buckets=(64,)),
+            params=new_params)
+        ref.warmup()
+        np.testing.assert_allclose(
+            pred_a1, np.asarray(ref.ring.state["pred"][:25]), atol=1e-5)
+        # b untouched
+        np.testing.assert_allclose(
+            np.asarray(pool.ring.state["pred"][slot_b, :25]), pred_b0,
+            atol=0.0)
+        ref.close()
+        pool.close()
 
     run(main())
