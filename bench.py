@@ -261,6 +261,269 @@ def run_supervised(args, argv: list) -> int:
     return 1
 
 
+# ---------------------------------------------------------------------------
+# --split: process-split deployment bench (VERDICT r3 item 7).
+#
+# Topology mirrors the reference's first process boundary (SURVEY §3.2):
+# THIS process runs the broker (BusServer over the in-proc bus) + the
+# event-sources endpoint + the simulator; a SECOND OS process runs the
+# rest of the pipeline (device-mgmt, inbound, event-mgmt, device-state,
+# rule-processing = the scorer) attached via RemoteEventBus — every
+# decoded record and every scored batch crosses a real socket.
+#
+# Measurement split (monotonic epochs are per-process, so no stamp may
+# cross the boundary): the parent measures THROUGHPUT by consuming the
+# scored-events topic; the child reports its own p50/p99 + stage
+# breakdown, which measure wire-decode → scored-published inside the
+# scorer process (ingest re-stamped at wire decode, kernel/wire.py).
+# ---------------------------------------------------------------------------
+
+_SPLIT_SCORER_SRC = r'''
+import asyncio, json, os, sys
+cfg = json.loads(sys.argv[1])
+if cfg["force_cpu"]:
+    # env alone does not stick in this image (interpreter startup
+    # re-asserts the accelerator platform): the jax.config update is
+    # what actually takes effect — same dance as tests/conftest.py
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, cfg["repo"])
+
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.model import DeviceType
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.kernel.wire import RemoteEventBus
+from sitewhere_tpu.services import (
+    DeviceManagementService, DeviceStateService, EventManagementService,
+    InboundProcessingService, RuleProcessingService,
+)
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+
+async def main():
+    rt = ServiceRuntime(
+        InstanceSettings(instance_id="split-bench"),
+        bus=RemoteEventBus("127.0.0.1", cfg["broker_port"]))
+    for cls in (DeviceManagementService, InboundProcessingService,
+                EventManagementService, DeviceStateService,
+                RuleProcessingService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    await rt.add_tenant(TenantConfig(tenant_id="bench", sections={
+        "event-management": {"history": cfg["history"]},
+        "rule-processing": {
+            "model": cfg["model"],
+            "model_config": {"window": cfg["window"]},
+            "threshold": 6.0, "batch_window_ms": cfg["window_ms"],
+            "buckets": [cfg["devices"]], "capacity": cfg["devices"],
+            "max_inflight": cfg["max_inflight"],
+        },
+    }))
+    dm = rt.api("device-management").management("bench")
+    dm.bootstrap_fleet(DeviceType(token="thermo", name="T"),
+                       cfg["devices"])
+    em = rt.api("event-management").management("bench")
+    sim = DeviceSimulator(SimConfig(num_devices=cfg["devices"]),
+                          tenant_id="bench")
+    for k in range(cfg["window"] + 4):
+        batch, _ = sim.tick(t=60.0 * k)
+        em.telemetry.append_measurements(batch)
+    eng = rt.api("rule-processing").engine("bench")
+    session = eng.session
+    while not session.ready:
+        await asyncio.sleep(0.1)
+    session.reload_history()
+    print("READY", flush=True)
+
+    stages = {nm: getattr(session, f"stage_{nm}")
+              for nm in ("admit", "batch", "device", "sink")}
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        cmd = line.strip()
+        if cmd == "RESET":
+            session.latency.reset()
+            for h in stages.values():
+                h.reset()
+            print("OK", flush=True)
+        elif cmd == "STATS":
+            print(json.dumps({
+                "scored": session.latency.count,
+                "p50_ms": round(session.latency.quantile(0.5) * 1e3, 3),
+                "p99_ms": round(session.latency.quantile(0.99) * 1e3, 3),
+                "p99_breakdown": {
+                    nm: {"p50_ms": round(h.quantile(0.5) * 1e3, 3),
+                         "p99_ms": round(h.quantile(0.99) * 1e3, 3)}
+                    for nm, h in stages.items()},
+                "inflight": session.inflight,
+            }), flush=True)
+        else:  # EXIT / EOF
+            break
+    await rt.stop()
+
+asyncio.run(main())
+'''
+
+
+async def run_split_bench(args) -> dict:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from sitewhere_tpu.config import InstanceSettings, TenantConfig
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.kernel.wire import BusServer
+    from sitewhere_tpu.services import EventSourcesService
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    # broker + ingest endpoint live here; the in-proc bus backs the
+    # broker (the runtime owns the bus lifecycle; the broker wraps it)
+    bus = EventBus(default_partitions=4)
+    rt = ServiceRuntime(InstanceSettings(instance_id="split-bench"),
+                        bus=bus)
+    rt.add_service(EventSourcesService(rt))
+    await rt.start()
+    broker = BusServer(bus)
+    await broker.start()
+    # the CHILD owns the tenant definition: its add_tenant broadcast on
+    # the shared topic spins engines in BOTH runtimes from one config
+    # (two competing add_tenant calls would respin each other's engines)
+
+    cfg = {"broker_port": broker.port, "devices": args.devices,
+           "history": args.history, "model": args.model,
+           "window": args.window, "window_ms": args.window_ms,
+           "max_inflight": args.max_inflight,
+           "force_cpu": os.environ.get("JAX_PLATFORMS") == "cpu",
+           "repo": os.path.dirname(os.path.abspath(__file__))}
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _SPLIT_SCORER_SRC, json.dumps(cfg)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+
+    loop = asyncio.get_running_loop()
+
+    async def child_line(timeout: float) -> str:
+        return await asyncio.wait_for(
+            loop.run_in_executor(None, proc.stdout.readline), timeout)
+
+    async def child_cmd(cmd: str, timeout: float = 30.0) -> str:
+        proc.stdin.write(cmd + "\n")
+        proc.stdin.flush()
+        return (await child_line(timeout)).strip()
+
+    # count scored events coming BACK over the broker (full round trip)
+    scored_consumer = bus.subscribe(
+        rt.naming.tenant_topic("bench", "scored-events"),
+        group="split-bench-meter")
+    scored_seen = 0
+
+    async def drain_scored():
+        nonlocal scored_seen
+        for r in scored_consumer.poll_nowait(max_records=512):
+            scored_seen += len(r.value)
+
+    try:
+        line = await child_line(args.ready_timeout)
+        assert line.strip() == "READY", f"scorer said {line!r}"
+        # our event-sources engine spun from the child's broadcast
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                receiver = (rt.api("event-sources").engine("bench")
+                            .receiver("default"))
+                break
+            except (KeyError, TimeoutError):
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.05)
+        sim = DeviceSimulator(SimConfig(num_devices=args.devices,
+                                        anomaly_rate=0.001,
+                                        anomaly_magnitude=12.0),
+                              tenant_id="bench")
+        t_base = 60.0 * (args.window + 4)
+        for k in range(3):  # end-to-end warm
+            await receiver.submit(sim.payload(t=t_base + k)[0])
+        await asyncio.sleep(1.0)
+        await drain_scored()
+        scored_seen = 0
+
+        # phase 1: saturation (open loop + drain)
+        t0 = time.monotonic()
+        sent = 0
+        k = 0
+        while time.monotonic() - t0 < args.seconds:
+            payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
+            await receiver.submit(payload)
+            sent += args.devices
+            k += 1
+            await drain_scored()
+        deadline = time.monotonic() + args.drain_timeout
+        while scored_seen < sent and time.monotonic() < deadline:
+            await drain_scored()
+            await asyncio.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        sat_ok = scored_seen >= sent
+        rate = scored_seen / elapsed if elapsed > 0 else 0.0
+
+        # phase 2: paced latency (child-side stats, reset first)
+        assert await child_cmd("RESET") == "OK"
+        paced_rate = args.paced_fraction * rate
+        interval = args.devices / max(paced_rate, 1.0)
+        scored_seen = 0
+        paced_sent = 0
+        t1 = time.monotonic()
+        next_t = t1
+        while time.monotonic() - t1 < args.latency_seconds:
+            payload, _ = sim.payload(t=t_base + 10_000 + 0.001 * paced_sent)
+            await receiver.submit(payload)
+            paced_sent += args.devices
+            next_t += interval
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await drain_scored()
+        deadline = time.monotonic() + args.latency_drain_timeout
+        while scored_seen < paced_sent and time.monotonic() < deadline:
+            await drain_scored()
+            await asyncio.sleep(0.02)
+        lat_ok = scored_seen >= paced_sent
+        stats = json.loads(await child_cmd("STATS"))
+
+        return {
+            "metric": "split_pipeline_scored_events_per_sec",
+            "value": round(rate, 1),
+            "unit": "events/s",
+            "vs_baseline": round(rate / 1_000_000, 4),
+            "deployment": "split (broker+ingest | scorer process)",
+            "p99_ms": stats["p99_ms"],
+            "p50_ms": stats["p50_ms"],
+            "p99_breakdown": stats["p99_breakdown"],
+            "latency_note": "child-side: wire decode -> scored "
+                            "(re-stamped at broker handoff)",
+            "paced_rate": round(paced_rate, 1),
+            "events_scored": int(scored_seen),
+            "seconds": round(elapsed, 2),
+            "model": args.model,
+            "fleet_devices": args.devices,
+            "drain": {"saturation_complete": sat_ok,
+                      "latency_complete": lat_ok},
+        }
+    finally:
+        try:
+            proc.stdin.write("EXIT\n")
+            proc.stdin.flush()
+        except (BrokenPipeError, ValueError):
+            pass
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        scored_consumer.close()
+        await broker.stop()
+        await rt.stop()
+
+
 def run_train_bench(args) -> dict:
     """Training-plane bench: ETL (windows/s) + train step rate (step/s,
     windows trained/s) for the selected model on the live backend."""
@@ -564,6 +827,10 @@ def main() -> None:
     parser.add_argument("--train", action="store_true",
                         help="bench the training plane (ETL windows/s + "
                              "train step/s) instead of the scoring pipeline")
+    parser.add_argument("--split", action="store_true",
+                        help="process-split deployment: broker + ingest "
+                             "here, the scorer in a second OS process over "
+                             "the wire bus (serve-bus topology)")
     parser.add_argument("--probe-horizon", type=float, default=600.0,
                         help="supervisor: total seconds to keep re-probing "
                              "a dead/hung backend before giving up")
@@ -595,6 +862,7 @@ def main() -> None:
         sys.exit(run_supervised(args, argv))
     try:
         result = (run_train_bench(args) if args.train
+                  else asyncio.run(run_split_bench(args)) if args.split
                   else asyncio.run(run_bench(args)))
     except BaseException as exc:  # noqa: BLE001 - the artifact must parse
         traceback.print_exc()

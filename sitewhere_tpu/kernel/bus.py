@@ -236,7 +236,13 @@ class BusConsumer:
         self._positions: dict[tuple[str, int], int] = {}
         self._generation = -1
         self._closed = False
-        self._wake: Optional[asyncio.Event] = None  # set while poll waits
+        self._wake: Optional[asyncio.Event] = None  # set while poll wait
+        # records trimmed past this member's read position before it got
+        # to them (retention overrun: the consumer paused — backpressure,
+        # warmup — longer than the retention window covers). At-least-once
+        # holds only WITHIN the retention window; this counter makes an
+        # overrun loud instead of a silent fast-forward.
+        self.lost_records = 0
 
     @property
     def assignment(self) -> tuple[tuple[str, int], ...]:
@@ -246,11 +252,19 @@ class BusConsumer:
         pos = self._positions.get(tp)
         if pos is None:
             state = self._bus._groups[self.group]
-            pos = state.committed.get(tp, 0)
+            committed = state.committed.get(tp)
             log = self._bus._topics[tp[0]].partitions[tp[1]]
-            if pos < log.base_offset:  # trimmed past committed offset
-                logger.warning("%s: offset %d behind base %d on %s, resetting",
-                               self.name, pos, log.base_offset, tp)
+            pos = committed if committed is not None else 0
+            if pos < log.base_offset:
+                if committed is not None:
+                    # trimmed past a COMMITTED offset: genuine loss. (A
+                    # group with no commit is just earliest-reset — it
+                    # never claimed those records.)
+                    self.lost_records += log.base_offset - pos
+                    logger.warning(
+                        "%s: offset %d behind base %d on %s — %d records "
+                        "trimmed unread (retention overrun)", self.name,
+                        pos, log.base_offset, tp, log.base_offset - pos)
                 pos = log.base_offset
             self._positions[tp] = pos
         return pos
@@ -265,7 +279,18 @@ class BusConsumer:
             log = self._bus._topics[topic_name].partitions[p]
             pos = self._position(tp)
             if pos < log.base_offset:
+                # a pause longer than retention covers (e.g. a consumer
+                # holding off while its sink is backlogged) trims records
+                # this member never read — account the loss loudly, and
+                # persist the fast-forward so the same trim is counted
+                # ONCE, not once per poll
+                self.lost_records += log.base_offset - pos
+                logger.warning(
+                    "%s: %d records on %s trimmed unread (retention "
+                    "overrun while paused)", self.name,
+                    log.base_offset - pos, tp)
                 pos = log.base_offset
+                self._positions[tp] = pos
             take = min(log.end_offset - pos, max_records - len(out))
             if take <= 0:
                 continue

@@ -43,12 +43,27 @@ _defaults_loaded = False
 
 
 def register_class(cls: type) -> type:
-    """Allow `cls` (a dataclass) on the wire."""
+    """Allow `cls` (a dataclass) on the wire.
+
+    The registry is keyed by bare class name (the wire format's type
+    tag); two DIFFERENT classes with one name would make decode
+    construct the wrong type, so a collision fails loudly at import."""
+    prev = _CLASSES.get(cls.__name__)
+    if prev is not None and prev is not cls:
+        raise ValueError(
+            f"wire name collision: {cls.__name__!r} already registered "
+            f"for {prev.__module__}.{prev.__qualname__}; cannot also map "
+            f"to {cls.__module__}.{cls.__qualname__}")
     _CLASSES[cls.__name__] = cls
     return cls
 
 
 def register_enum(cls: type) -> type:
+    prev = _ENUMS.get(cls.__name__)
+    if prev is not None and prev is not cls:
+        raise ValueError(
+            f"wire name collision: enum {cls.__name__!r} already "
+            f"registered for {prev.__module__}.{prev.__qualname__}")
     _ENUMS[cls.__name__] = cls
     return cls
 

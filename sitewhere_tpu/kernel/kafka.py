@@ -11,10 +11,12 @@ Kafka's own.
 
 This image has no Kafka client library (aiokafka is not baked in), so
 the adapter import-gates: constructing it without aiokafka raises a
-clear error, and the bus CONTRACT tests (tests/test_bus_contract.py)
-run the identical suite against the in-proc and wire buses — the Kafka
-rows activate automatically wherever aiokafka + a broker exist
-(`SWX_KAFKA_BOOTSTRAP` env).
+clear error unless a client module is injected. The in-repo fake
+(kernel/fake_kafka.py) implements the aiokafka surface this adapter
+uses, so the bus CONTRACT tests (tests/test_bus_contract.py) run the
+identical suite against in-proc, wire, AND this adapter in every image;
+the rows hit a real broker wherever aiokafka + `SWX_KAFKA_BOOTSTRAP`
+exist.
 """
 
 from __future__ import annotations
@@ -35,22 +37,29 @@ except ImportError:  # pragma: no cover - exercised only without the lib
 
 
 class KafkaEventBus:
-    """`EventBus` surface over a real Kafka cluster (aiokafka)."""
+    """`EventBus` surface over a real Kafka cluster (aiokafka).
 
-    def __init__(self, bootstrap_servers: str, client_id: str = "swx"):
-        if aiokafka is None:
+    `client_mod` injects the client library (default: aiokafka). The
+    in-repo `kernel.fake_kafka` implements the same surface so the
+    adapter's logic — serializer wiring, group/commit bookkeeping, the
+    poll loop — runs and is contract-tested in images with no broker."""
+
+    def __init__(self, bootstrap_servers: str, client_id: str = "swx", *,
+                 client_mod=None):
+        self._mod = client_mod if client_mod is not None else aiokafka
+        if self._mod is None:
             raise RuntimeError(
                 "KafkaEventBus needs the aiokafka package; this image "
                 "does not bake it in — use the in-proc bus or the wire "
                 "bus broker (`swx serve-bus`) instead")
         self.bootstrap = bootstrap_servers
         self.client_id = client_id
-        self._producer: Optional["aiokafka.AIOKafkaProducer"] = None
+        self._producer = None
         self._consumers: list["KafkaBusConsumer"] = []
 
     # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
     async def initialize(self) -> None:
-        self._producer = aiokafka.AIOKafkaProducer(
+        self._producer = self._mod.AIOKafkaProducer(
             bootstrap_servers=self.bootstrap, client_id=self.client_id,
             value_serializer=codec.encode,
             key_serializer=lambda k: k.encode() if k else None)
@@ -102,12 +111,12 @@ class KafkaBusConsumer:
         self._topics = topics
         self.group = group
         self.name = name
-        self._consumer: Optional["aiokafka.AIOKafkaConsumer"] = None
+        self._consumer = None
         self._closed = False
 
     async def _ensure(self) -> None:
         if self._consumer is None:
-            self._consumer = aiokafka.AIOKafkaConsumer(
+            self._consumer = self._bus._mod.AIOKafkaConsumer(
                 *self._topics,
                 bootstrap_servers=self._bus.bootstrap,
                 group_id=self.group, client_id=self.name,
@@ -135,7 +144,7 @@ class KafkaBusConsumer:
         if self._consumer is None:
             return
         if positions is not None:
-            offsets = {aiokafka.TopicPartition(t, p): off
+            offsets = {self._bus._mod.TopicPartition(t, p): off
                        for (t, p), off in positions.items()}
             coro = self._consumer.commit(offsets)
         else:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from typing import Any, Iterable, Optional
 
 from sitewhere_tpu.kernel import codec
@@ -325,8 +326,19 @@ class RemoteBusConsumer:
         rows = await self._client.call("poll", cid=self.cid,
                                        max_records=max_records,
                                        timeout=timeout)
-        return [TopicRecord(t, p, off, key, value, ts)
-                for t, p, off, key, value, ts in rows]
+        now = time.monotonic()
+        out = []
+        for t, p, off, key, value, ts in rows:
+            # cross-process: the producer stamped ctx.ingest_monotonic in
+            # ITS monotonic epoch, which is unrelated to ours — latency
+            # stages computed against it would be garbage (possibly
+            # negative). Re-stamp at wire decode; admit/e2e latency in a
+            # split deployment measures from broker handoff, documented.
+            ctx = getattr(value, "ctx", None)
+            if ctx is not None and hasattr(ctx, "ingest_monotonic"):
+                ctx.ingest_monotonic = now
+            out.append(TopicRecord(t, p, off, key, value, ts))
+        return out
 
     def commit(self, positions: Optional[dict] = None) -> None:
         rows = None
@@ -507,6 +519,10 @@ class ApiServer(WireServer):
         target = self._target(msg)
         sub = msg.get("sub")
         if sub:  # e.g. management()/state() accessor before the method
+            if sub.startswith("_"):
+                # same guard as `method`: the accessor must not reach the
+                # private surface the method check hides
+                raise PermissionError(f"accessor {sub!r} not exposed")
             target = getattr(target, sub)
             if callable(target):
                 target = target()

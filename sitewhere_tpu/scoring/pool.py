@@ -54,6 +54,13 @@ class PoolConfig:
     mtype: int = 0
     seed: int = 0
     max_inflight: int = 64
+    # per-tenant admission backlog (events) before that tenant's slot
+    # reports `backlogged`; 0 → 4 × batch_buckets[-1] (see ScoringConfig)
+    backlog_cap: int = 0
+
+    @property
+    def backlog_events(self) -> int:
+        return self.backlog_cap or 4 * self.batch_buckets[-1]
 
 
 @dataclass
@@ -62,9 +69,9 @@ class _TenantEntry:
     telemetry: TelemetryStore
     threshold: float
     deliver: Deliver
+    # (device_index, value, ts, ingest, ctx, admit_monotonic)
     pending: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
-                        BatchContext]] = \
-        field(default_factory=list)  # (device_index, value, ts, ingest, ctx)
+                        BatchContext, float]] = field(default_factory=list)
     pending_n: int = 0
     inflight: int = 0          # this tenant's share of in-flight flushes
 
@@ -79,6 +86,13 @@ class TenantSlot:
         self.tenant_id = tenant_id
         self.scored_meter = pool.scored_meter
         self.latency = pool.latency
+        # stage decomposition is POOL-wide (all tenants share one flusher
+        # and one histogram set), exposed per-slot so pooled and
+        # dedicated sinks present the same surface to the bench
+        self.stage_admit = pool.stage_admit
+        self.stage_batch = pool.stage_batch
+        self.stage_device = pool.stage_device
+        self.stage_sink = pool.stage_sink
 
     @property
     def ready(self) -> bool:
@@ -100,8 +114,10 @@ class TenantSlot:
     @property
     def backlogged(self) -> bool:
         """This tenant's admission backlog is at capacity; its consumer
-        must pause polling (backpressure, not post-consume drops)."""
-        return self.pending_n >= 16 * self.pool.cfg.batch_buckets[-1]
+        must pause polling (backpressure, not post-consume drops).
+        At-least-once then holds only within the bus retention window
+        (see ScoringSession.backlogged)."""
+        return self.pending_n >= self.pool.cfg.backlog_events
 
     @property
     def inflight(self) -> int:
@@ -191,6 +207,12 @@ class SharedScoringPool:
         self.flush_rounds = metrics.counter("scoring.pool_flush_rounds")
         self.dropped = metrics.counter("scoring.admissions_dropped")
         self.sink_failures = metrics.counter("scoring.sink_failures")
+        # latency decomposition, pool-wide (same stage semantics as
+        # ScoringSession: admit → batch → device → sink)
+        self.stage_admit = metrics.histogram("scoring.stage_admit_s")
+        self.stage_batch = metrics.histogram("scoring.stage_batch_s")
+        self.stage_device = metrics.histogram("scoring.stage_device_s")
+        self.stage_sink = metrics.histogram("scoring.stage_sink_s")
 
     @property
     def settled_through(self) -> int:
@@ -329,8 +351,10 @@ class SharedScoringPool:
                             batch.ts[mask])
         if dev.shape[0] == 0:
             return
+        now = time.monotonic()
+        self.stage_admit.observe(now - batch.ctx.ingest_monotonic)
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
-        entry.pending.append((dev, val, ts, ingest, batch.ctx))
+        entry.pending.append((dev, val, ts, ingest, batch.ctx, now))
         entry.pending_n += dev.shape[0]
         if dev.shape[0]:
             self._pending_max = max(self._pending_max, int(dev.max()))
@@ -399,6 +423,7 @@ class SharedScoringPool:
             taken: list[tuple] = []
             traces = []
             budget = self.cfg.batch_buckets[-1]
+            now = time.monotonic()
             while e.pending and budget > 0:
                 p = e.pending[0]
                 n = p[0].shape[0]
@@ -408,11 +433,13 @@ class SharedScoringPool:
                     traces.append((p[4].trace_id, n))
                     budget -= n
                 else:
-                    head = tuple(c[:budget] for c in p[:4]) + (p[4],)
-                    e.pending[0] = tuple(c[budget:] for c in p[:4]) + (p[4],)
+                    head = tuple(c[:budget] for c in p[:4]) + (p[4], p[5])
+                    e.pending[0] = tuple(c[budget:] for c in p[:4]) \
+                        + (p[4], p[5])
                     taken.append(head)
                     traces.append((p[4].trace_id, budget))
                     budget = 0
+                self.stage_batch.observe(now - p[5])
             e.pending_n = sum(p[0].shape[0] for p in e.pending)
             if e.pending_n:
                 self._wake.set()
@@ -505,6 +532,7 @@ class SharedScoringPool:
                 raise
             now = time.monotonic()
             self.batch_latency.observe(now - t0)
+            self.stage_device.observe(now - t0)
             for tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx in metas:
                 e = self.tenants.get(tid)
                 if e is None:  # unregistered mid-flight
@@ -527,11 +555,14 @@ class SharedScoringPool:
                     for trace_id, n_ev in traces:
                         self.tracer.record(trace_id, "rule-processing.score",
                                            tid, t0, now - t0, n_ev)
+                t_sink = time.monotonic()
                 try:
                     await e.deliver(scored)
                 except Exception:  # noqa: BLE001 - one tenant can't sink the pool
                     self.sink_failures.inc()
                     logger.exception("pool deliver failed for tenant %s", tid)
+                else:
+                    self.stage_sink.observe(time.monotonic() - t_sink)
         finally:
             self.inflight -= 1
             self.settled_count += 1

@@ -60,6 +60,16 @@ class ScoringConfig:
     seed: int = 0
     max_inflight: int = 64          # dispatched-not-settled flush bound
     capacity: int = 0               # fleet-size hint: pre-size the ring
+    # admission backlog (events) before `backlogged` engages consumer
+    # backpressure; 0 → 4 × buckets[-1]. Latency-oriented: a standing
+    # queue of B events adds B/rate seconds of tail — 4 full buckets
+    # keeps the pipeline fed through settle jitter without letting an
+    # overload build a 100 ms queue (the old 16× did).
+    backlog_cap: int = 0
+
+    @property
+    def backlog_events(self) -> int:
+        return self.backlog_cap or 4 * self.buckets[-1]
 
 
 class ScoringSession:
@@ -298,8 +308,12 @@ class ScoringSession:
         sustained overload). The CONSUMER must stop polling while this
         holds — backpressure through uncommitted bus offsets preserves
         the documented at-least-once guarantee; silently dropping events
-        that were already consumed (the old drop-oldest) did not."""
-        return self._pending_n >= 16 * self.cfg.buckets[-1]
+        that were already consumed (the old drop-oldest) did not.
+
+        Caveat: at-least-once holds only within the bus's retention
+        window — a pause longer than retention covers trims unread
+        records (counted in `BusConsumer.lost_records`)."""
+        return self._pending_n >= self.cfg.backlog_events
 
     @property
     def idle(self) -> bool:

@@ -305,18 +305,30 @@ class WebSocketEventReceiver(BackgroundTaskComponent):
     """WebSocket ingest endpoint (reference analog: the WebSocket
     receiver): devices connect to ws://host:port/ws/<client-id> and send
     binary SWB1 (or JSON) messages; server→client frames carry command
-    downlink via the session registry (services/websocket.py)."""
+    downlink via the session registry (services/websocket.py).
+
+    `tokens: {client_id: token}` — when present, the Upgrade must carry
+    `Authorization: Bearer <token>` (or `?token=`) matching the client
+    id in the path; otherwise 401. The session registry routes command
+    downlink by client id (and ids are printed in QR labels), so an
+    unauthenticated peer must never occupy one — same trust model the
+    MQTT endpoint enforces at CONNECT."""
 
     def __init__(self, name: str, engine: "EventSourcesEngine",
                  decoder: EventDecoder, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, tokens: Optional[dict] = None):
         super().__init__(name)
         self.engine = engine
         self.decoder = decoder
+        self.tokens = dict(tokens) if tokens else None
         from sitewhere_tpu.services.websocket import WebSocketListener
 
-        self.listener = WebSocketListener(self._on_message, host=host,
-                                          port=port)
+        self.listener = WebSocketListener(
+            self._on_message, host=host, port=port,
+            authenticate=self._authenticate if self.tokens else None)
+
+    def _authenticate(self, client_id: str, token) -> bool:
+        return token is not None and self.tokens.get(client_id) == token
 
     @property
     def port(self) -> int:
@@ -325,6 +337,44 @@ class WebSocketEventReceiver(BackgroundTaskComponent):
     async def _on_message(self, payload: bytes, client_id: str) -> None:
         await self.engine.process_payload(
             payload, f"{self.name}:{client_id}", self.decoder,
+            ingest_monotonic=time.monotonic())
+
+    async def _do_start(self, monitor) -> None:
+        await self.listener.start()
+
+    async def _run(self) -> None:  # server runs itself
+        await asyncio.Event().wait()
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        await self.listener.stop()
+
+
+class CoapEventReceiver(BackgroundTaskComponent):
+    """CoAP ingest endpoint (reference analog: the Californium-based
+    CoAP receiver): constrained devices POST SWB1 (or JSON) payloads to
+    coap://host:port/<path> over UDP; CON requests are ACKed and
+    deduplicated, malformed datagrams are counted and dropped
+    (services/coap.py)."""
+
+    def __init__(self, name: str, engine: "EventSourcesEngine",
+                 decoder: EventDecoder, host: str = "127.0.0.1",
+                 port: int = 0, path: str = "telemetry"):
+        super().__init__(name)
+        self.engine = engine
+        self.decoder = decoder
+        from sitewhere_tpu.services.coap import CoapListener
+
+        self.listener = CoapListener(self._on_payload, host=host, port=port,
+                                     path=path)
+
+    @property
+    def port(self) -> int:
+        return self.listener.port
+
+    async def _on_payload(self, payload: bytes, source: str) -> None:
+        await self.engine.process_payload(
+            payload, f"{self.name}:{source}", self.decoder,
             ingest_monotonic=time.monotonic())
 
     async def _do_start(self, monitor) -> None:
@@ -391,7 +441,13 @@ class EventSourcesEngine(TenantEngine):
         elif kind == "websocket":
             r = WebSocketEventReceiver(name, self, decoder,
                                        host=cfg.get("host", "127.0.0.1"),
-                                       port=cfg.get("port", 0))
+                                       port=cfg.get("port", 0),
+                                       tokens=cfg.get("tokens"))
+        elif kind == "coap":
+            r = CoapEventReceiver(name, self, decoder,
+                                  host=cfg.get("host", "127.0.0.1"),
+                                  port=cfg.get("port", 0),
+                                  path=cfg.get("path", "telemetry"))
         else:
             raise ValueError(f"unknown receiver kind {kind!r}")
         self.receivers.append(r)

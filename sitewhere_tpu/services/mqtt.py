@@ -173,6 +173,16 @@ class MqttListener:
                     self.sessions.pop(s.client_id, None)
         return n
 
+    async def publish(self, topic: str, payload: bytes,
+                      retain: bool = False) -> int:
+        """Server-originated PUBLISH: live fan-out to matching
+        subscribers, optionally retained for late subscribers — the
+        one public entry point that keeps the retain protocol rule
+        (store, then deliver unretained live copies) in this class."""
+        if retain:
+            self._retain(topic, payload)
+        return await self.publish_to_subscribers(topic, payload)
+
     def _retain(self, topic: str, payload: bytes) -> None:
         if not payload:  # zero-length retained PUBLISH clears (spec §3.3.1.3)
             self.retained.pop(topic, None)

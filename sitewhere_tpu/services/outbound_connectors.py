@@ -12,6 +12,15 @@ registry + filter chain. Built-ins:
 - `topic`: republish (optionally filtered) onto another bus topic —
   composition primitive for custom pipelines
 - `callable`: wrap any async function (the Groovy-connector analog)
+- `webhook`: HTTP POST JSON to an external endpoint (dependency-free
+  asyncio HTTP/1.1 client) with retry/backoff; exhausted retries
+  dead-letter the record to a bus topic — the
+  InitialState/dweet/HTTP-bridge analog, and the generic "push to any
+  external system" connector
+- `mqtt`: republish JSON out through the tenant's MQTT broker endpoint
+  (services/mqtt.py fan-out, optionally retained) — external
+  subscribers (dashboards, SCADA bridges) receive enriched/scored
+  events live, the MqttOutboundConnector analog
 
 Filters (reference: IDeviceEventFilter): event-kind allowlist, device
 allowlist (by index range or explicit set), score threshold for
@@ -20,10 +29,12 @@ ScoredBatch records. Filters compose with AND semantics.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import time
 from typing import Awaitable, Callable, Optional
+from urllib.parse import urlsplit
 
 import numpy as np
 
@@ -176,6 +187,100 @@ class CallableConnector(Connector):
         await self.fn(value)
 
 
+class WebhookConnector(Connector):
+    """POST each (filtered) record as JSON to an external HTTP endpoint.
+
+    Dependency-free asyncio HTTP/1.1 client (http:// only — this image
+    terminates TLS at the edge; an https URL raises at config time, not
+    silently downgrades). Failures retry with exponential backoff; a
+    record that exhausts its retries is DEAD-LETTERED to a bus topic so
+    an operator can replay it — never silently dropped."""
+
+    def __init__(self, name: str, url: str, bus, dead_letter_topic: str,
+                 filter: Optional[EventFilter] = None, retries: int = 3,
+                 backoff_s: float = 0.2, timeout_s: float = 10.0):
+        super().__init__(name, filter)
+        parts = urlsplit(url)
+        if parts.scheme != "http":
+            raise ValueError(f"webhook connector supports http:// only, "
+                             f"got {url!r}")
+        self.url = url
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or 80
+        self.path = (parts.path or "/") + (
+            f"?{parts.query}" if parts.query else "")
+        self.bus = bus
+        self.dead_letter_topic = dead_letter_topic
+        self.retries = max(1, retries)
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        self.delivered = 0
+        self.dead_lettered = 0
+
+    async def _post(self, body: bytes) -> int:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout_s)
+        try:
+            writer.write(
+                (f"POST {self.path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                 f"Content-Type: application/json\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 f"Connection: close\r\n\r\n").encode() + body)
+            await writer.drain()
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                 self.timeout_s)
+            return int(status_line.split()[1])
+        finally:
+            writer.close()
+
+    async def sink(self, value) -> None:
+        body = json.dumps(record_to_jsonable(value)).encode()
+        delay = self.backoff_s
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            try:
+                status = await self._post(body)
+                if 200 <= status < 300:
+                    self.delivered += 1
+                    return
+                last = RuntimeError(f"HTTP {status}")
+            except (OSError, asyncio.TimeoutError, ValueError,
+                    IndexError) as exc:
+                last = exc
+            if attempt < self.retries - 1:
+                await asyncio.sleep(delay)
+                delay *= 2
+        self.dead_lettered += 1
+        logger.warning("webhook %s → %s failed after %d attempts (%s); "
+                       "dead-lettering", self.name, self.url, self.retries,
+                       last)
+        await self.bus.produce(self.dead_letter_topic, value, key=self.name)
+
+
+class MqttRepublishConnector(Connector):
+    """Republish (filtered) records as JSON out through the tenant's
+    MQTT broker endpoint: one PUBLISH on `<topic_prefix><kind>` per
+    record, fanned out live to matching external subscribers, optionally
+    retained so late subscribers see the latest record per kind."""
+
+    def __init__(self, name: str, listener_fn, topic_prefix: str = "swx/outbound/",
+                 filter: Optional[EventFilter] = None, retain: bool = False):
+        super().__init__(name, filter)
+        # lazily resolved: the MQTT endpoint (event-sources) may not be
+        # started when connector config is parsed
+        self.listener_fn = listener_fn  # () -> services.mqtt.MqttListener
+        self.topic_prefix = topic_prefix
+        self.retain = retain
+        self.published = 0
+
+    async def sink(self, value) -> None:
+        listener = self.listener_fn()
+        payload = json.dumps(record_to_jsonable(value)).encode()
+        topic = f"{self.topic_prefix}{_kind(value)}"
+        self.published += await listener.publish(topic, payload,
+                                                 retain=self.retain)
+
+
 class OutboundConnectorsEngine(TenantEngine):
     """(reference: OutboundConnectorsManager)"""
 
@@ -200,6 +305,26 @@ class OutboundConnectorsEngine(TenantEngine):
             conn = JsonlConnector(name, c["path"], filt)
         elif kind == "topic":
             conn = TopicConnector(name, self.runtime.bus, c["topic"], filt)
+        elif kind == "webhook":
+            conn = WebhookConnector(
+                name, c["url"], self.runtime.bus,
+                c.get("dead_letter_topic")
+                or self.tenant_topic("outbound-dead-letter"),
+                filt, retries=c.get("retries", 3),
+                backoff_s=c.get("backoff_s", 0.2),
+                timeout_s=c.get("timeout_s", 10.0))
+        elif kind == "mqtt":
+            receiver_name = c.get("receiver", "mqtt")
+
+            def listener_fn(receiver_name=receiver_name):
+                return (self.runtime.api("event-sources")
+                        .engine(self.tenant_id)
+                        .receiver(receiver_name).listener)
+
+            conn = MqttRepublishConnector(
+                name, listener_fn,
+                topic_prefix=c.get("topic_prefix", "swx/outbound/"),
+                filter=filt, retain=c.get("retain", False))
         else:
             raise ValueError(f"unknown connector kind {kind!r}")
         self.connectors[name] = conn

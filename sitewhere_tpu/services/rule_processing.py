@@ -108,6 +108,7 @@ class RuleProcessingEngine(TenantEngine):
                                   self.runtime.settings.scoring_batch_buckets)),
             capacity=cfg.get("capacity", 0),
             max_inflight=cfg.get("max_inflight", 64),
+            backlog_cap=cfg.get("backlog_cap", 0),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.shared: bool = cfg.get("shared", False)
@@ -224,6 +225,11 @@ class RuleProcessor(BackgroundTaskComponent):
         consumer = runtime.bus.subscribe(
             engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
             group=f"{tenant_id}.rule-processing")
+        # retention-overrun accounting: while paused on backpressure the
+        # bus keeps trimming, so at-least-once holds only within the
+        # retention window — records trimmed unread surface here
+        lost_counter = runtime.metrics.counter("scoring.bus_records_lost")
+        lost_seen = 0
         # checkpointed commit state: (dispatch_count at snapshot, positions)
         ckpt: Optional[tuple[int, dict]] = None
         try:
@@ -232,8 +238,10 @@ class RuleProcessor(BackgroundTaskComponent):
                     # backpressure: the scorer's admission backlog is at
                     # capacity (warmup compile, regrow, overload). Stop
                     # consuming — records stay in the bus uncommitted
-                    # (at-least-once preserved) instead of being dropped
-                    # after consume. Keep flushing so the backlog drains.
+                    # (at-least-once within the retention window; past it
+                    # the consumer's lost_records counts the trim) instead
+                    # of being dropped after consume. Keep flushing so the
+                    # backlog drains.
                     if session is not None and session.flush_due:
                         session.flush_nowait()
                     await asyncio.sleep(
@@ -242,6 +250,10 @@ class RuleProcessor(BackgroundTaskComponent):
                 timeout = sink.flush_wait_s if sink else 0.2
                 records = await consumer.poll(max_records=64,
                                               timeout=max(timeout, 0.001))
+                lost = getattr(consumer, "lost_records", 0)
+                if lost > lost_seen:
+                    lost_counter.inc(lost - lost_seen)
+                    lost_seen = lost
                 for record in records:
                     value = record.value
                     if sink is not None and isinstance(value, MeasurementBatch):
@@ -314,7 +326,8 @@ class RuleProcessingService(Service):
                 model, self.runtime.metrics,
                 PoolConfig(batch_buckets=scoring_cfg.buckets,
                            batch_window_ms=scoring_cfg.batch_window_ms,
-                           mtype=scoring_cfg.mtype, seed=scoring_cfg.seed),
+                           mtype=scoring_cfg.mtype, seed=scoring_cfg.seed,
+                           backlog_cap=scoring_cfg.backlog_cap),
                 mesh=mesh, tracer=self.runtime.tracer)
             self._pools[key] = pool
         return pool

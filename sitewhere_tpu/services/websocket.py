@@ -53,11 +53,23 @@ class WsSession:
 
 class WebSocketListener:
     """Asyncio WebSocket server; `on_message(payload, client_id)` is
-    awaited for every complete binary/text message."""
+    awaited for every complete binary/text message.
 
-    def __init__(self, on_message, host: str = "127.0.0.1", port: int = 0):
+    Security (mirrors MqttListener's hooks; None = open, loopback/test):
+    - `authenticate(client_id, token) -> bool`: checked during the
+      Upgrade handshake; the token comes from `Authorization: Bearer`
+      (or `?token=`). A failed check gets 401 and no upgrade — the
+      session registry (which routes command downlink by client id) is
+      never populated with an unauthenticated peer.
+    - duplicate client ids are REJECTED (409), not silently replaced:
+      a later connection must not hijack an existing session's downlink.
+    """
+
+    def __init__(self, on_message, host: str = "127.0.0.1", port: int = 0,
+                 authenticate=None):
         self.on_message = on_message
         self.host, self.port = host, port
+        self.authenticate = authenticate
         self.sessions: dict[str, WsSession] = {}
         self._conns: set[asyncio.StreamWriter] = set()
         self._server: Optional[asyncio.AbstractServer] = None
@@ -114,15 +126,55 @@ class WebSocketListener:
                          b"Content-Length: 0\r\n\r\n")
             await writer.drain()
             return None
-        writer.write(
-            b"HTTP/1.1 101 Switching Protocols\r\n"
-            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
-            b"Sec-WebSocket-Accept: " + _accept_key(key).encode()
-            + b"\r\n\r\n")
-        await writer.drain()
+        path, _, query = path.partition("?")
         seg = path.rstrip("/").rsplit("/", 1)[-1]
         peer = writer.get_extra_info("peername")
-        return seg or (f"{peer[0]}:{peer[1]}" if peer else "anon")
+        client_id = seg or (f"{peer[0]}:{peer[1]}" if peer else "anon")
+        if self.authenticate is not None:
+            auth = headers.get("authorization", "")
+            token = auth[7:] if auth.lower().startswith("bearer ") else None
+            if token is None:
+                for part in query.split("&"):
+                    k, _, v = part.partition("=")
+                    if k == "token":
+                        token = v
+            if not self.authenticate(client_id, token):
+                writer.write(b"HTTP/1.1 401 Unauthorized\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+                await writer.drain()
+                return None
+        if client_id in self.sessions:
+            if self.authenticate is None:
+                # an id's session routes its command downlink: an
+                # UNPROVEN second connection must not take it over
+                writer.write(b"HTTP/1.1 409 Conflict\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+                await writer.drain()
+                return None
+            # the peer proved ownership of this id (token checked
+            # above): replace the old session — with no server-side
+            # ping, a dead socket is only noticed here, and a device
+            # rebooting after an unclean disconnect must be able to
+            # reconnect without waiting for a process restart
+            stale = self.sessions.pop(client_id)
+            try:
+                stale.writer.close()
+            except RuntimeError:
+                pass
+        # reserve BEFORE the drain await: two racing handshakes for one
+        # id must not both pass the check above
+        self.sessions[client_id] = WsSession(client_id, writer)
+        try:
+            writer.write(
+                b"HTTP/1.1 101 Switching Protocols\r\n"
+                b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                b"Sec-WebSocket-Accept: " + _accept_key(key).encode()
+                + b"\r\n\r\n")
+            await writer.drain()
+        except BaseException:
+            self.sessions.pop(client_id, None)  # failed upgrade can't
+            raise                               # orphan the reservation
+        return client_id
 
     async def _read_frame(self, reader) -> tuple[int, bool, bytes]:
         b1, b2 = await reader.readexactly(2)
@@ -150,8 +202,7 @@ class WebSocketListener:
             client_id = await self._handshake(reader, writer)
             if client_id is None:
                 return
-            session = WsSession(client_id, writer)
-            self.sessions[client_id] = session
+            session = self.sessions[client_id]  # reserved in _handshake
             buffer = bytearray()
             while True:
                 opcode, fin, payload = await self._read_frame(reader)
@@ -177,6 +228,9 @@ class WebSocketListener:
             pass
         finally:
             self._conns.discard(writer)
-            if session is not None:
+            if (session is not None
+                    and self.sessions.get(session.client_id) is session):
+                # identity check: a stale handler's teardown must not
+                # evict a NEWER live session registered under the same id
                 self.sessions.pop(session.client_id, None)
             writer.close()

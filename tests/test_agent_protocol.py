@@ -310,3 +310,292 @@ def test_mqtt_fan_out_and_retained(run):
             w2.close()
 
     run(main())
+
+
+# -- WebSocket security (advisor round-3 findings) ---------------------------
+
+
+async def _ws_try_connect(port: int, path: str, headers: str = ""):
+    """Raw Upgrade attempt; returns the HTTP status code line."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    key = base64.b64encode(os.urandom(16)).decode()
+    writer.write((f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                  f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                  f"Sec-WebSocket-Key: {key}\r\n"
+                  f"Sec-WebSocket-Version: 13\r\n"
+                  f"{headers}\r\n").encode())
+    await writer.drain()
+    resp = await reader.readuntil(b"\r\n\r\n")
+    status = resp.split(b"\r\n")[0].decode()
+    return status, reader, writer
+
+
+def test_websocket_auth_and_duplicate_rejection(run):
+    """An unauthenticated peer must not occupy a session slot (the
+    registry routes command downlink by client id, and ids are printed
+    in QR labels). Duplicate ids: with auth configured, a peer that
+    PROVES ownership replaces the stale session (a device rebooting
+    after an unclean disconnect must be able to reconnect — there is no
+    server-side ping to reap dead sockets); without auth, a duplicate
+    is rejected (409) because ownership can't be proven."""
+
+    async def main():
+        from sitewhere_tpu.services.websocket import WebSocketListener
+
+        got = []
+
+        async def on_message(payload, client_id):
+            got.append((client_id, payload))
+
+        listener = WebSocketListener(
+            on_message,
+            authenticate=lambda cid, tok: tok == f"secret-{cid}")
+        await listener.start()
+        try:
+            # no token → 401, no session
+            status, _, w = await _ws_try_connect(listener.port, "/ws/dev-1")
+            assert "401" in status
+            w.close()
+            assert "dev-1" not in listener.sessions
+            # wrong token → 401
+            status, _, w = await _ws_try_connect(
+                listener.port, "/ws/dev-1",
+                "Authorization: Bearer nope\r\n")
+            assert "401" in status
+            w.close()
+            # right token (header) → 101 + session registered
+            status, r1, w1 = await _ws_try_connect(
+                listener.port, "/ws/dev-1",
+                "Authorization: Bearer secret-dev-1\r\n")
+            assert "101" in status
+            assert "dev-1" in listener.sessions
+            first_session = listener.sessions["dev-1"]
+            # the authenticated session ingests
+            w1.write(_ws_client_frame(b"hello"))
+            await w1.drain()
+            await wait_until(lambda: len(got) == 1, timeout=5.0)
+            assert got[0] == ("dev-1", b"hello")
+            # PROVEN duplicate (device rebooted, same token) replaces the
+            # stale session — not locked out until process restart
+            status, r2, w2 = await _ws_try_connect(
+                listener.port, "/ws/dev-1",
+                "Authorization: Bearer secret-dev-1\r\n")
+            assert "101" in status
+            second = listener.sessions["dev-1"]
+            assert second is not first_session
+            # the new session ingests; the stale handler's teardown must
+            # NOT evict it (identity-guarded cleanup)
+            await asyncio.sleep(0.1)  # let the old handler unwind
+            assert listener.sessions.get("dev-1") is second
+            w2.write(_ws_client_frame(b"again"))
+            await w2.drain()
+            await wait_until(lambda: len(got) == 2, timeout=5.0)
+            assert got[1] == ("dev-1", b"again")
+            # query-param token form also accepted
+            status, _, w3 = await _ws_try_connect(
+                listener.port, "/ws/dev-2?token=secret-dev-2")
+            assert "101" in status
+            # session closing frees the id for reconnection
+            w2.close()
+            await wait_until(lambda: "dev-1" not in listener.sessions,
+                             timeout=5.0)
+            w1.close()
+            w3.close()
+        finally:
+            await listener.stop()
+
+        # WITHOUT auth there is no ownership proof: duplicate → 409
+        open_listener = WebSocketListener(on_message)
+        await open_listener.start()
+        try:
+            status, _, w1 = await _ws_try_connect(open_listener.port,
+                                                  "/ws/dev-9")
+            assert "101" in status
+            first = open_listener.sessions["dev-9"]
+            status, _, w2 = await _ws_try_connect(open_listener.port,
+                                                  "/ws/dev-9")
+            assert "409" in status
+            assert open_listener.sessions["dev-9"] is first
+            w1.close()
+            w2.close()
+        finally:
+            await open_listener.stop()
+
+    run(main())
+
+
+# -- CoAP (RFC 7252) ---------------------------------------------------------
+
+
+def _coap_post(path: str, payload: bytes, mid: int, mtype: int = 0,
+               token: bytes = b"\x42") -> bytes:
+    """Minimal client-side CoAP POST with Uri-Path options."""
+    out = bytearray([(1 << 6) | (mtype << 4) | len(token), 0x02])
+    out += mid.to_bytes(2, "big")
+    out += token
+    number = 0
+    for seg in path.split("/"):
+        seg_b = seg.encode()
+        delta = 11 - number
+        assert delta < 13 and len(seg_b) < 13  # test-sized paths
+        out.append((delta << 4) | len(seg_b))
+        out += seg_b
+        number = 11
+    if payload:
+        out += b"\xff" + payload
+    return bytes(out)
+
+
+class _UdpClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.replies: asyncio.Queue = asyncio.Queue()
+        self.transport = None
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        self.replies.put_nowait(data)
+
+
+async def _udp_client(port: int) -> _UdpClient:
+    loop = asyncio.get_running_loop()
+    _, proto = await loop.create_datagram_endpoint(
+        _UdpClient, remote_addr=("127.0.0.1", port))
+    return proto
+
+
+def test_coap_ingest_scores_anomaly_and_dedups_retransmit(run):
+    """e2e: an SWB1 payload POSTed over CoAP (CON) is ACKed (2.04,
+    token+mid echoed), decoded, persisted, and scored into an anomaly
+    alert; a retransmitted CON re-ACKs without double-ingesting."""
+
+    async def main():
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "coap", "decoder": "swb1", "name": "coap"}]},
+            "rule-processing": {"model": "zscore",
+                                "model_config": {"window": 16},
+                                "threshold": 5.0, "batch_window_ms": 1.0},
+        }
+        async with running_pipeline(num_devices=20,
+                                    sections=sections) as rt:
+            sim = DeviceSimulator(SimConfig(num_devices=20, seed=9),
+                                  tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme") \
+                .receiver("default")
+            for k in range(20):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 400)
+
+            coap = rt.api("event-sources").engine("acme").receiver("coap")
+            client = await _udp_client(coap.port)
+            sim.cfg = SimConfig(num_devices=20, seed=9, anomaly_rate=1.0,
+                                anomaly_magnitude=20.0)
+            payload, truth = sim.payload(t=21 * 60.0)
+            assert truth.all()
+            msg = _coap_post("telemetry", payload, mid=7, mtype=0)
+            client.transport.sendto(msg)
+            ack = await asyncio.wait_for(client.replies.get(), 5.0)
+            # ACK (type 2), code 2.04, mid 7, token echoed
+            assert (ack[0] >> 4) & 0x3 == 2
+            assert ack[1] == 0x44
+            assert int.from_bytes(ack[2:4], "big") == 7
+            assert ack[4:5] == b"\x42"
+
+            await wait_until(
+                lambda: em.telemetry.total_events == 420, timeout=10.0)
+            await wait_until(
+                lambda: any(a.event_date == 21 * 60.0
+                            for a in em.list_alerts()), timeout=15.0)
+
+            # retransmission (same mid): re-ACKed, NOT re-ingested
+            client.transport.sendto(msg)
+            ack2 = await asyncio.wait_for(client.replies.get(), 5.0)
+            assert ack2[1] == 0x44
+            await asyncio.sleep(0.3)
+            assert em.telemetry.total_events == 420
+            assert coap.listener.accepted == 1
+
+            # NON (type 1) with a fresh payload ingests silently
+            payload2, _ = sim.payload(t=22 * 60.0)
+            client.transport.sendto(
+                _coap_post("telemetry", payload2, mid=8, mtype=1))
+            await wait_until(
+                lambda: em.telemetry.total_events == 440, timeout=10.0)
+            client.transport.close()
+
+    run(main())
+
+
+def test_coap_malformed_fuzz_and_error_codes(run):
+    """Fuzzed datagrams must never kill the endpoint; bad paths/methods
+    get the right 4.xx piggybacked codes."""
+
+    async def main():
+        from sitewhere_tpu.services.coap import CoapListener
+
+        got = []
+
+        async def on_payload(payload, source):
+            got.append(payload)
+
+        listener = CoapListener(on_payload, path="telemetry")
+        await listener.start()
+        try:
+            client = await _udp_client(listener.port)
+            rng = np.random.default_rng(0)
+            valid = _coap_post("telemetry", b"x" * 20, mid=1)
+            for i in range(200):
+                n = int(rng.integers(0, 64))
+                client.transport.sendto(bytes(rng.integers(0, 256, n,
+                                                           dtype=np.uint8)))
+                # truncations of a valid message too
+                client.transport.sendto(valid[:int(rng.integers(0,
+                                                                len(valid)))])
+            await asyncio.sleep(0.2)
+            assert listener.malformed > 0
+            # endpoint still alive and correct after the fuzz:
+            # wrong path → 4.04
+            client.transport.sendto(_coap_post("nope", b"x", mid=2))
+            replies = client.replies
+            while True:  # drain any RSTs the fuzz provoked
+                r = await asyncio.wait_for(replies.get(), 5.0)
+                if int.from_bytes(r[2:4], "big") == 2:
+                    break
+            assert r[1] == 0x84
+            # retransmission of the REJECTED request replays 4.04 — a
+            # lost error ACK must not turn into 2.04 success on retry
+            client.transport.sendto(_coap_post("nope", b"x", mid=2))
+            while True:
+                r = await asyncio.wait_for(replies.get(), 5.0)
+                if int.from_bytes(r[2:4], "big") == 2:
+                    break
+            assert r[1] == 0x84
+            # GET → 4.05
+            get = bytearray(_coap_post("telemetry", b"", mid=3))
+            get[1] = 0x01
+            client.transport.sendto(bytes(get))
+            while True:
+                r = await asyncio.wait_for(replies.get(), 5.0)
+                if int.from_bytes(r[2:4], "big") == 3:
+                    break
+            assert r[1] == 0x85
+            # and a valid POST still lands
+            client.transport.sendto(_coap_post("telemetry", b"hello", mid=4))
+            while True:
+                r = await asyncio.wait_for(replies.get(), 5.0)
+                if int.from_bytes(r[2:4], "big") == 4:
+                    break
+            assert r[1] == 0x44
+            # (a truncation that cuts inside the payload is itself a
+            # well-formed shorter message — UDP length delimits the
+            # payload — so the fuzz may have legitimately ingested one)
+            await wait_until(lambda: b"hello" in got, timeout=5.0)
+            client.transport.close()
+        finally:
+            await listener.stop()
+
+    run(main())

@@ -165,3 +165,61 @@ def test_close_wakes_blocked_poll(run):
         assert waited < 0.3
 
     run(main())
+
+
+def test_retention_overrun_counts_lost_records(run):
+    """Advisor round-3 finding: while a consumer pauses (backpressure),
+    the log keeps trimming; records trimmed past its read position must
+    be COUNTED, not silently fast-forwarded — at-least-once holds only
+    within the retention window."""
+
+    async def main():
+        bus = EventBus(default_partitions=1, retention=5)
+        c = bus.subscribe("t", group="g")
+        for i in range(4):
+            await bus.produce("t", i)
+        assert [r.value for r in await c.poll(max_records=100)] \
+            == [0, 1, 2, 3]
+        assert c.lost_records == 0
+        # consumer pauses; 12 more records overrun the 5-record window
+        for i in range(4, 16):
+            await bus.produce("t", i)
+        records = await c.poll(max_records=100)
+        assert [r.value for r in records] == [11, 12, 13, 14, 15]
+        # positions 4..10 were trimmed unread
+        assert c.lost_records == 7
+
+    run(main())
+
+
+def test_new_group_on_trimmed_topic_is_not_lost_records(run):
+    """A brand-new group joining a topic whose base offset has advanced
+    is an earliest-reset, NOT a retention overrun — no spurious loss
+    alarm. And a fully-trimmed idle partition is counted ONCE, not once
+    per poll."""
+
+    async def main():
+        bus = EventBus(default_partitions=1, retention=5)
+        for i in range(20):
+            await bus.produce("t", i)
+        late = bus.subscribe("t", group="late-joiner")
+        records = await late.poll(max_records=100)
+        assert [r.value for r in records] == [15, 16, 17, 18, 19]
+        assert late.lost_records == 0  # never claimed the trimmed ones
+
+        # genuine overrun counted exactly once across repeated polls
+        c = bus.subscribe("t", group="g")
+        await c.poll(max_records=100)
+        c.commit()
+        c.close()
+        for i in range(20, 40):  # trim far past the committed offset
+            await bus.produce("t", i)
+        c2 = bus.subscribe("t", group="g")
+        await c2.poll(max_records=100)
+        first = c2.lost_records
+        assert first > 0
+        for _ in range(5):
+            c2.poll_nowait()
+        assert c2.lost_records == first
+
+    run(main())

@@ -299,7 +299,7 @@ def test_admission_backpressure_never_drops(run):
             ScoringConfig(buckets=(128,), threshold=4.0))
         session.ready = False  # simulate a long warmup/regrow
         total = 0
-        for k in range(30):  # 30 * 100 = 3000 > 16 * 128 = 2048 cap
+        for k in range(30):  # 30 * 100 = 3000 > default cap 4*128 = 512
             batch, _ = sim.tick(t=(40 + k) * 60.0)
             session.admit(batch)
             total += len(batch)
@@ -319,6 +319,33 @@ def test_admission_backpressure_never_drops(run):
         await session.drain()
         assert sum(scored) == total
         assert not session.backlogged
+        session.close()
+
+    run(main())
+
+
+def test_backlog_cap_is_configurable(run):
+    """The admission cap is a latency knob (a standing queue of B events
+    adds B/rate seconds of tail): default 4 full buckets, overridable
+    per tenant via `backlog_cap`."""
+
+    async def main():
+        assert ScoringConfig(buckets=(128,)).backlog_events == 512
+        assert ScoringConfig(buckets=(128,),
+                             backlog_cap=100).backlog_events == 100
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=50, seed=1), tenant_id="t")
+        _fill_store(store, sim, 40)
+        session = ScoringSession(
+            build_model("zscore", window=32), store, MetricsRegistry(),
+            ScoringConfig(buckets=(128,), backlog_cap=100))
+        session.ready = False
+        batch, _ = sim.tick(t=40 * 60.0)
+        session.admit(batch)  # 50 events < 100
+        assert not session.backlogged
+        batch, _ = sim.tick(t=41 * 60.0)
+        session.admit(batch)  # 100 events >= 100
+        assert session.backlogged
         session.close()
 
     run(main())

@@ -333,3 +333,134 @@ def test_chaos_service_restart_mid_stream(run):
                              timeout=15.0)
 
     run(main())
+
+
+def test_webhook_and_mqtt_republish_connectors(run):
+    """Round-4 VERDICT item 5: REAL outbound connectors. An external
+    HTTP endpoint (fake server) and an external MQTT subscriber (raw
+    socket through the broker endpoint) both receive an enriched scored
+    record, with filter composition (kind + min_score) and webhook
+    retry-through-failure exercised end to end."""
+
+    async def main():
+        from tests.test_mqtt import connect_pkt, read_pkt, subscribe_pkt
+
+        hits: list = []
+        fail_first = [2]  # first two POSTs fail → retry/backoff path
+
+        async def handle(reader, writer):
+            try:
+                head = await reader.readuntil(b"\r\n\r\n")
+                length = 0
+                for line in head.decode("latin-1").split("\r\n"):
+                    if line.lower().startswith("content-length"):
+                        length = int(line.split(":")[1])
+                body = await reader.readexactly(length)
+                if fail_first[0] > 0:
+                    fail_first[0] -= 1
+                    writer.write(b"HTTP/1.1 500 Oops\r\n"
+                                 b"Content-Length: 0\r\n\r\n")
+                else:
+                    hits.append(json.loads(body))
+                    writer.write(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 0\r\n\r\n")
+                await writer.drain()
+            finally:
+                writer.close()
+
+        http_server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        http_port = http_server.sockets[0].getsockname()[1]
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "mqtt", "decoder": "swb1", "name": "mqtt",
+                 "subscribe_allow": ["swx/outbound/"]}]},
+            "outbound-connectors": {"connectors": [
+                {"kind": "webhook", "name": "wh",
+                 "url": f"http://127.0.0.1:{http_port}/ingest",
+                 "kinds": ["scored"], "min_score": 4.0, "backoff_s": 0.05},
+                {"kind": "mqtt", "name": "mq", "receiver": "mqtt",
+                 "kinds": ["scored"], "min_score": 4.0}]},
+        }
+        try:
+            async with full_instance(sections, num_devices=30) as rt:
+                mqtt_port = (rt.api("event-sources").engine("acme")
+                             .receiver("mqtt").port)
+                # external dashboard subscribes to the outbound space
+                r, w = await asyncio.open_connection("127.0.0.1", mqtt_port)
+                w.write(connect_pkt("dashboard"))
+                await w.drain()
+                ptype, _, _ = await read_pkt(r)
+                assert ptype == 2  # CONNACK
+                w.write(subscribe_pkt("swx/outbound/#"))
+                await w.drain()
+                ptype, _, body = await read_pkt(r)
+                assert ptype == 9 and body[2] != 0x80  # SUBACK granted
+
+                sim = DeviceSimulator(SimConfig(num_devices=30, seed=5),
+                                      tenant_id="acme")
+                receiver = (rt.api("event-sources").engine("acme")
+                            .receiver("default"))
+                for k in range(20):
+                    await receiver.submit(sim.payload(t=60.0 * k)[0])
+                sim.cfg = SimConfig(num_devices=30, seed=5, anomaly_rate=0.3,
+                                    anomaly_magnitude=15.0)
+                payload, truth = sim.payload(t=21 * 60.0)
+                await receiver.submit(payload)
+
+                # webhook: retried through the two 500s, then delivered
+                # only scored records with score >= 4.0
+                await wait_until(lambda: hits, timeout=20.0)
+                assert hits[0]["kind"] == "scored"
+                assert min(hits[0]["score"]) >= 4.0
+                engine = rt.api("outbound-connectors").engine("acme")
+                assert engine.connectors["wh"].delivered >= 1
+                assert engine.connectors["wh"].dead_lettered == 0
+                assert fail_first[0] == 0  # the retry path actually ran
+
+                # MQTT: the external subscriber received the republish
+                ptype, _, body = await asyncio.wait_for(read_pkt(r), 10.0)
+                assert ptype == 3  # PUBLISH
+                tlen = int.from_bytes(body[:2], "big")
+                assert body[2:2 + tlen] == b"swx/outbound/scored"
+                doc = json.loads(body[2 + tlen:])
+                assert doc["kind"] == "scored"
+                assert min(doc["score"]) >= 4.0
+                w.close()
+        finally:
+            http_server.close()
+
+    run(main())
+
+
+def test_webhook_dead_letters_on_exhausted_retries(run):
+    """A webhook whose endpoint is down must dead-letter the record to
+    the bus (replayable), never drop it silently."""
+
+    async def main():
+        from sitewhere_tpu.kernel.bus import EventBus
+        from sitewhere_tpu.services.outbound_connectors import (
+            EventFilter,
+            WebhookConnector,
+        )
+
+        # a port with nothing listening: connect refused instantly
+        probe = await asyncio.start_server(lambda r, w: None, "127.0.0.1", 0)
+        dead_port = probe.sockets[0].getsockname()[1]
+        probe.close()
+        await probe.wait_closed()
+
+        bus = EventBus(default_partitions=1)
+        conn = WebhookConnector(
+            "wh", f"http://127.0.0.1:{dead_port}/x", bus, "dead-letter",
+            EventFilter(), retries=2, backoff_s=0.01, timeout_s=1.0)
+        sim = DeviceSimulator(SimConfig(num_devices=5), tenant_id="t")
+        batch, _ = sim.tick(t=0.0)
+        await conn.process(batch)
+        assert conn.dead_lettered == 1 and conn.delivered == 0
+        c = bus.subscribe("dead-letter", group="replay")
+        records = await c.poll(max_records=10, timeout=2.0)
+        assert len(records) == 1
+        assert len(records[0].value) == len(batch)  # the record, intact
+
+    run(main())

@@ -261,3 +261,60 @@ def test_three_process_instance_scores_end_to_end(run):
         await broker_bus.stop()
 
     run(main())
+
+
+def test_codec_rejects_wire_name_collision():
+    """Two different classes under one wire name would make decode
+    construct the wrong type; registration must fail loudly instead."""
+    import dataclasses
+
+    import pytest
+
+    @dataclasses.dataclass
+    class CollideMe:
+        x: int = 0
+
+    codec.register_class(CollideMe)
+    try:
+        # same name, different class object → loud failure
+        @dataclasses.dataclass
+        class CollideMe:  # noqa: F811
+            y: str = ""
+
+        with pytest.raises(ValueError, match="collision"):
+            codec.register_class(CollideMe)
+    finally:
+        codec._CLASSES.pop("CollideMe", None)
+
+
+def test_api_server_blocks_private_sub_accessor(run):
+    """The '_'-guard on method names must also cover the `sub` accessor
+    (advisor round-3: sub='_pending' reached private state)."""
+
+    async def main():
+        from sitewhere_tpu.kernel.wire import ApiServer
+
+        class FakeService:
+            def api(self):
+                return self
+
+            def ping(self):
+                return "pong"
+
+        class FakeRuntime:
+            services = {"svc": FakeService()}
+
+        server = ApiServer(FakeRuntime(), host="127.0.0.1", port=0)
+        ok = await server._op_call(
+            {"identifier": "svc", "method": "ping"})
+        assert ok == "pong"
+        import pytest
+
+        with pytest.raises(PermissionError):
+            await server._op_call(
+                {"identifier": "svc", "method": "ping", "sub": "_secret"})
+        with pytest.raises(PermissionError):
+            await server._op_call(
+                {"identifier": "svc", "method": "_private"})
+
+    run(main())
