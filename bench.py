@@ -524,6 +524,95 @@ async def run_split_bench(args) -> dict:
         await rt.stop()
 
 
+def run_gnn_bench(args) -> dict:
+    """Config-5 bench: fleet graph build (host) → GNN risk scoring
+    (device) at fleet sizes 1k and 10k. Reports graph-build wall time
+    and sustained risk scores/s per size; `value` is the largest
+    fleet's scoring rate. One padded full-graph XLA call scores the
+    whole fleet (models/gnn.py), so the rate is (devices × iters) /
+    elapsed after a warm compile."""
+    import jax
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from sitewhere_tpu.domain.model import (
+        Area,
+        Asset,
+        Device,
+        DeviceAssignment,
+        DeviceType,
+    )
+    from sitewhere_tpu.models.graph import build_fleet_graph
+    from sitewhere_tpu.persistence.memory import InMemoryDeviceManagement
+    from sitewhere_tpu.persistence.telemetry import TelemetryStore
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+    from sitewhere_tpu.training.maintenance import (
+        MaintenanceTrainer,
+        build_maintenance_model,
+    )
+
+    platform, device_kind, n_chips = probe_backend()
+    model = build_maintenance_model()
+    trainer = MaintenanceTrainer(model)
+    params = model.init(jax.random.PRNGKey(0))
+    sizes = [1000, 10000]
+    per_size = {}
+    for n in sizes:
+        dm = InMemoryDeviceManagement()
+        dt = DeviceType(token="pump", name="Pump")
+        dm.create_device_type(dt)
+        assets = [Asset(token=f"asset-{i}", name=f"A{i}")
+                  for i in range(max(n // 50, 1))]
+        parent = Area(token="site", name="Site")
+        areas = [parent] + [Area(token=f"area-{i}", name=f"Z{i}",
+                                 parent_area_id=parent.id)
+                            for i in range(max(n // 200, 1))]
+        for ar in areas:
+            dm.create_area(ar)
+        for i in range(n):
+            d = dm.create_device(Device(token=f"p-{i}",
+                                        device_type_id=dt.id))
+            dm.create_device_assignment(DeviceAssignment(
+                device_id=d.id, token=f"p-{i}-a",
+                asset_id=assets[i % len(assets)].id,
+                area_id=areas[1 + i % (len(areas) - 1)].id
+                if len(areas) > 1 else parent.id))
+        store = TelemetryStore(history=args.window * 2, initial_devices=n)
+        sim = DeviceSimulator(SimConfig(num_devices=n), tenant_id="bench")
+        for k in range(args.window + 4):
+            store.append_measurements(sim.tick(t=60.0 * k)[0])
+
+        t0 = time.monotonic()
+        graph = build_fleet_graph(dm, store, window=args.window)
+        build_s = time.monotonic() - t0
+        trainer.score(params, graph)  # warm compile at this padded shape
+        iters = 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < max(args.seconds / 2, 2.0):
+            risk = trainer.score(params, graph)
+            iters += 1
+        elapsed = time.monotonic() - t0
+        assert risk.shape[0] == n and np.isfinite(risk).all()
+        per_size[str(n)] = {
+            "graph_build_ms": round(build_s * 1e3, 1),
+            "graph_nodes": graph.n_pad,
+            "risk_scores_per_sec": round(n * iters / elapsed, 1),
+            "scoring_iters": iters,
+        }
+    top = per_size[str(sizes[-1])]
+    return {
+        "metric": "gnn_fleet_risk_scores_per_sec",
+        "value": top["risk_scores_per_sec"],
+        "unit": "device-risk-scores/s",
+        "vs_baseline": 0.0,  # no reference GNN plane exists
+        "fleet_sizes": per_size,
+        "model": "gnn",
+        "platform": platform, "device_kind": device_kind, "chips": n_chips,
+    }
+
+
 def run_train_bench(args) -> dict:
     """Training-plane bench: ETL (windows/s) + train step rate (step/s,
     windows trained/s) for the selected model on the live backend."""
@@ -831,6 +920,9 @@ def main() -> None:
                         help="process-split deployment: broker + ingest "
                              "here, the scorer in a second OS process over "
                              "the wire bus (serve-bus topology)")
+    parser.add_argument("--gnn", action="store_true",
+                        help="config-5 bench: fleet graph build + GNN "
+                             "risk scoring at fleet sizes 1k/10k")
     parser.add_argument("--probe-horizon", type=float, default=600.0,
                         help="supervisor: total seconds to keep re-probing "
                              "a dead/hung backend before giving up")
@@ -862,6 +954,7 @@ def main() -> None:
         sys.exit(run_supervised(args, argv))
     try:
         result = (run_train_bench(args) if args.train
+                  else run_gnn_bench(args) if args.gnn
                   else asyncio.run(run_split_bench(args)) if args.split
                   else asyncio.run(run_bench(args)))
     except BaseException as exc:  # noqa: BLE001 - the artifact must parse

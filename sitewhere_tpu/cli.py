@@ -18,6 +18,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import signal
 import sys
 import time
@@ -108,7 +109,8 @@ def _validate_split(services, remotes):
                 f"{sorted(services)} consume it over the wire")
 
 
-def _build_runtime(settings, tenants, services=None, bus=None, remotes=None):
+def _build_runtime(settings, tenants, services=None, bus=None, remotes=None,
+                   wire_secret=None):
     """Assemble a runtime. `services` (names) selects a subset for
     process-split deployment; `bus` may be a RemoteEventBus; `remotes`
     maps identifier -> (host, port) of peers hosting other services."""
@@ -126,7 +128,7 @@ def _build_runtime(settings, tenants, services=None, bus=None, remotes=None):
         if services is None or name in services:
             rt.add_service(cls(rt))
     for identifier, (host, port) in (remotes or {}).items():
-        rt.add_remote_service(identifier, host, port)
+        rt.add_remote_service(identifier, host, port, secret=wire_secret)
     return rt
 
 
@@ -147,9 +149,11 @@ async def cmd_serve_bus(args) -> int:
                    retention=args.retention)
     await bus.initialize()
     await bus.start()
-    server = BusServer(bus, host=args.host, port=args.port)
+    secret = args.secret or os.environ.get("SWX_WIRE_SECRET")
+    server = BusServer(bus, host=args.host, port=args.port, secret=secret)
     await server.start()
-    print(f"swx bus broker on {server.host}:{server.port}", flush=True)
+    print(f"swx bus broker on {server.host}:{server.port}"
+          + (" (auth required)" if secret else ""), flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -183,11 +187,13 @@ async def cmd_run(args) -> int:
 
     # process-split deployment: subset of services + shared wire bus +
     # remote peers (reference: 14 cooperating processes over Kafka+gRPC)
+    wire_secret = getattr(args, "secret", None) \
+        or os.environ.get("SWX_WIRE_SECRET")
     bus = None
     if args.bus:
         from sitewhere_tpu.kernel.wire import RemoteEventBus
 
-        bus = RemoteEventBus(*_parse_addr(args.bus))
+        bus = RemoteEventBus(*_parse_addr(args.bus), secret=wire_secret)
     services = set(args.services.split(",")) if args.services else None
     remotes = {}
     for spec in args.remote or ():
@@ -198,13 +204,14 @@ async def cmd_run(args) -> int:
         remotes[identifier] = _parse_addr(addr)
 
     rt = _build_runtime(settings, tenants, services=services, bus=bus,
-                        remotes=remotes)
+                        remotes=remotes, wire_secret=wire_secret)
     await rt.start()
     api_server = None
     if args.api_port is not None:
         from sitewhere_tpu.kernel.wire import ApiServer
 
-        api_server = ApiServer(rt, host="127.0.0.1", port=args.api_port)
+        api_server = ApiServer(rt, host="127.0.0.1", port=args.api_port,
+                               secret=wire_secret)
         await api_server.start()
         print(f"swx api server on 127.0.0.1:{api_server.port}", flush=True)
     if args.no_tenants:
@@ -391,12 +398,19 @@ def main(argv=None) -> int:
     p_run.add_argument("--no-tenants", action="store_true",
                        help="don't create tenants here (a peer process "
                             "broadcasts them over the shared bus)")
+    p_run.add_argument("--secret",
+                       help="shared secret for wire bus/API connections "
+                            "(default: SWX_WIRE_SECRET env)")
 
     p_bus = sub.add_parser("serve-bus", help="run the wire bus broker")
     p_bus.add_argument("--host", default="127.0.0.1")
     p_bus.add_argument("--port", type=int, default=47900)
     p_bus.add_argument("--partitions", type=int, default=4)
     p_bus.add_argument("--retention", type=int, default=4096)
+    p_bus.add_argument("--secret",
+                       help="require this shared secret from every wire "
+                            "peer (default: SWX_WIRE_SECRET env; unset = "
+                            "open, loopback/test use)")
 
     p_sim = sub.add_parser("simulate", help="stream SWB1 at a TCP gateway")
     p_sim.add_argument("--host", default="127.0.0.1")

@@ -218,13 +218,14 @@ class ServiceRuntime(LifecycleComponent):
         self.add_child(service)
         return service
 
-    def add_remote_service(self, identifier: str, host: str,
-                           port: int) -> Any:
+    def add_remote_service(self, identifier: str, host: str, port: int,
+                           secret: Optional[str] = None) -> Any:
         """Register a peer process's service: `api(identifier)` and
         `wait_for_engine` resolve to wire proxies (kernel/wire.py)."""
         from sitewhere_tpu.kernel.wire import ApiChannel, RemoteService
 
-        remote = RemoteService(identifier, ApiChannel(host, port))
+        remote = RemoteService(identifier, ApiChannel(host, port,
+                                                      secret=secret))
         self.remotes[identifier] = remote
         return remote
 

@@ -44,10 +44,19 @@ _MAX_FRAME = 256 * 1024 * 1024
 
 class WireServer:
     """Asyncio TCP server dispatching `{"op": ...}` requests to handler
-    coroutines. Subclasses populate `self.handlers`."""
+    coroutines. Subclasses populate `self.handlers`.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    `secret` (optional): shared-secret handshake — the FIRST frame of
+    every connection must be `{"op": "auth", "token": <secret>}` or the
+    connection is closed before any op is served. The wire plane stays
+    plaintext (it mirrors the reference's internal gRPC trust model:
+    same trusted network), but a listening port no longer accepts
+    arbitrary peers. Compare is constant-time."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
         self.host, self.port = host, port
+        self.secret = secret
         self.handlers: dict[str, Any] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -75,11 +84,40 @@ class WireServer:
     def on_disconnect(self, writer: asyncio.StreamWriter) -> None:
         """Subclass hook: a peer connection dropped."""
 
+    async def _auth_handshake(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> bool:
+        import hmac
+
+        header = await asyncio.wait_for(reader.readexactly(8), 10.0)
+        length = int.from_bytes(header[:4], "little")
+        req_id = int.from_bytes(header[4:], "little")
+        ok = False
+        if length <= 4096:
+            body = await asyncio.wait_for(reader.readexactly(length), 10.0)
+            try:
+                msg = codec.decode(body)
+                ok = (msg.get("op") == "auth"
+                      and isinstance(msg.get("token"), str)
+                      and hmac.compare_digest(msg["token"], self.secret))
+            except Exception:  # noqa: BLE001 - any garbage is a failed auth
+                ok = False
+        payload = codec.encode(
+            {"ok": True} if ok else {"err": "PermissionError: wire auth "
+                                            "failed"})
+        writer.write(len(payload).to_bytes(4, "little")
+                     + req_id.to_bytes(4, "little") + payload)
+        await writer.drain()
+        return ok
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         self._conns.add(writer)
         tasks: set[asyncio.Task] = set()
         try:
+            if self.secret is not None:
+                if not await asyncio.wait_for(
+                        self._auth_handshake(reader, writer), 15.0):
+                    return
             while True:
                 header = await reader.readexactly(8)
                 length = int.from_bytes(header[:4], "little")
@@ -91,7 +129,8 @@ class WireServer:
                     self._dispatch(req_id, body, writer))
                 tasks.add(task)
                 task.add_done_callback(tasks.discard)
-        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError,
+                asyncio.TimeoutError):
             pass
         finally:
             for t in tasks:
@@ -124,8 +163,9 @@ class WireClient:
     """Multiplexed request/response client (one connection, many
     outstanding calls — long-polls don't serialize)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, secret: Optional[str] = None):
         self.host, self.port = host, port
+        self.secret = secret
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -153,6 +193,9 @@ class WireClient:
                 await asyncio.sleep(retry_interval)
         self._rx_task = asyncio.create_task(self._rx_loop(),
                                             name=f"wire-rx-{self.port}")
+        if self.secret is not None:
+            # must be the connection's first frame (server handshake)
+            await self.call("auth", token=self.secret)
 
     async def _rx_loop(self) -> None:
         try:
@@ -234,8 +277,9 @@ class WireClient:
 class BusServer(WireServer):
     """Host an `EventBus` for remote peers (the broker process)."""
 
-    def __init__(self, bus: EventBus, host: str = "127.0.0.1", port: int = 0):
-        super().__init__(host, port)
+    def __init__(self, bus: EventBus, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
+        super().__init__(host, port, secret=secret)
         self.bus = bus
         self._consumers: dict[int, Any] = {}
         self._by_conn: dict[asyncio.StreamWriter, set[int]] = {}
@@ -377,9 +421,9 @@ class RemoteEventBus:
     accepts it via its `bus=` parameter and starts/stops it like the
     in-proc bus."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, secret: Optional[str] = None):
         self.host, self.port = host, port
-        self._client = WireClient(host, port)
+        self._client = WireClient(host, port, secret=secret)
 
     # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
     async def initialize(self) -> None:
@@ -490,8 +534,9 @@ class ApiServer(WireServer):
     method calls on services/engines (the reference's per-service gRPC
     APIs with tenant-token demux [SURVEY.md §2.1])."""
 
-    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
-        super().__init__(host, port)
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
+        super().__init__(host, port, secret=secret)
         self.runtime = runtime
         self.handlers = {
             "wait_engine": self._op_wait_engine,
@@ -564,8 +609,8 @@ class RemoteEngineProxy:
 class ApiChannel:
     """Client side of `ApiServer` (reference: `ApiChannel`)."""
 
-    def __init__(self, host: str, port: int):
-        self._client = WireClient(host, port)
+    def __init__(self, host: str, port: int, secret: Optional[str] = None):
+        self._client = WireClient(host, port, secret=secret)
 
     async def wait_engine(self, identifier: str, tenant: str,
                           timeout: float = 30.0) -> bool:

@@ -115,6 +115,10 @@ class CoapListener(asyncio.DatagramProtocol):
         self.malformed = 0
         self.accepted = 0
         self._transport: Optional[asyncio.DatagramTransport] = None
+        # processing tasks are retained until done: the loop holds tasks
+        # only weakly, and a GC'd pending task would drop an ACKed
+        # payload (whose retransmit the dedup cache then absorbs)
+        self._tasks: set[asyncio.Task] = set()
         # (addr, mid) -> (deadline, response bytes): retransmissions of a
         # CON replay the ORIGINAL response (a lost 4.xx ACK must not turn
         # into a 2.04 on retry); insertion-ordered for expiry
@@ -198,8 +202,10 @@ class CoapListener(asyncio.DatagramProtocol):
             # acceptance is what CoAP acknowledges
             self._reply_con(addr, mid, build_message(
                 TYPE_ACK, CODE_CHANGED, mid, token))
-        asyncio.get_running_loop().create_task(
+        task = asyncio.get_running_loop().create_task(
             self._process(payload, addr))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def _process(self, payload: bytes, addr) -> None:
         try:

@@ -218,20 +218,25 @@ class WebhookConnector(Connector):
         self.dead_lettered = 0
 
     async def _post(self, body: bytes) -> int:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port), self.timeout_s)
-        try:
-            writer.write(
-                (f"POST {self.path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                 f"Content-Type: application/json\r\n"
-                 f"Content-Length: {len(body)}\r\n"
-                 f"Connection: close\r\n\r\n").encode() + body)
-            await writer.drain()
-            status_line = await asyncio.wait_for(reader.readline(),
-                                                 self.timeout_s)
-            return int(status_line.split()[1])
-        finally:
-            writer.close()
+        async def attempt() -> int:
+            reader, writer = await asyncio.open_connection(self.host,
+                                                           self.port)
+            try:
+                writer.write(
+                    (f"POST {self.path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                     f"Content-Type: application/json\r\n"
+                     f"Content-Length: {len(body)}\r\n"
+                     f"Connection: close\r\n\r\n").encode() + body)
+                await writer.drain()
+                status_line = await reader.readline()
+                return int(status_line.split()[1])
+            finally:
+                writer.close()
+
+        # ONE bound over connect + write/drain + status read: an endpoint
+        # that accepts but stops reading must not wedge the outbound loop
+        # (connectors run serially per record) past the timeout
+        return await asyncio.wait_for(attempt(), self.timeout_s)
 
     async def sink(self, value) -> None:
         body = json.dumps(record_to_jsonable(value)).encode()
@@ -315,6 +320,15 @@ class OutboundConnectorsEngine(TenantEngine):
                 timeout_s=c.get("timeout_s", 10.0))
         elif kind == "mqtt":
             receiver_name = c.get("receiver", "mqtt")
+            if "event-sources" not in self.runtime.services:
+                # split deployment with event-sources in a peer process:
+                # the republish path needs the LOCAL broker listener
+                # object — fail at config time, not per record at sink
+                raise ValueError(
+                    "mqtt outbound connector needs event-sources hosted "
+                    "in THIS process (its broker listener is used "
+                    "directly); colocate the services or use a webhook/"
+                    "topic connector instead")
 
             def listener_fn(receiver_name=receiver_name):
                 return (self.runtime.api("event-sources")

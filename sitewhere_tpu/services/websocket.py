@@ -61,8 +61,10 @@ class WebSocketListener:
       (or `?token=`). A failed check gets 401 and no upgrade — the
       session registry (which routes command downlink by client id) is
       never populated with an unauthenticated peer.
-    - duplicate client ids are REJECTED (409), not silently replaced:
-      a later connection must not hijack an existing session's downlink.
+    - duplicate client ids REPLACE the existing session (MQTT CONNECT
+      takeover semantics): with auth on, the newcomer just proved
+      ownership; session hijack by a peer that cannot pass auth is
+      impossible, and an uncleanly-disconnected device can reconnect.
     """
 
     def __init__(self, on_message, host: str = "127.0.0.1", port: int = 0,
@@ -144,18 +146,14 @@ class WebSocketListener:
                 await writer.drain()
                 return None
         if client_id in self.sessions:
-            if self.authenticate is None:
-                # an id's session routes its command downlink: an
-                # UNPROVEN second connection must not take it over
-                writer.write(b"HTTP/1.1 409 Conflict\r\n"
-                             b"Content-Length: 0\r\n\r\n")
-                await writer.drain()
-                return None
-            # the peer proved ownership of this id (token checked
-            # above): replace the old session — with no server-side
-            # ping, a dead socket is only noticed here, and a device
-            # rebooting after an unclean disconnect must be able to
-            # reconnect without waiting for a process restart
+            # duplicate id: REPLACE the old session (MQTT's own CONNECT
+            # takeover semantics). With no server-side ping, a dead
+            # socket is only noticed here — a device rebooting after an
+            # unclean disconnect must be able to reconnect without a
+            # process restart. With auth configured the newcomer proved
+            # ownership (token checked above); without auth a 409 would
+            # add no protection (any peer could claim the id FIRST) while
+            # handing attackers a lockout primitive.
             stale = self.sessions.pop(client_id)
             try:
                 stale.writer.close()

@@ -53,6 +53,9 @@ class MaintenanceTrainer:
         self.mesh = mesh
         self.opt = optax.adamw(cfg.learning_rate,
                                weight_decay=cfg.weight_decay)
+        # cached jitted risk fn: `jax.jit(self.model.risk)` per call
+        # would build a fresh wrapper (and retrace) on every score
+        self._risk_fn = None
 
     def _place(self, graph: FleetGraph):
         """Device-put graph arrays; shard the node axis when meshed."""
@@ -103,7 +106,9 @@ class MaintenanceTrainer:
     def score(self, params: dict, graph: FleetGraph) -> np.ndarray:
         """Per-device maintenance risk [n_devices] float32 in [0, 1]."""
         feat, nbrs, mask, _, _ = self._place(graph)
-        risk = jax.jit(self.model.risk)(params, feat, nbrs, mask)
+        if self._risk_fn is None:
+            self._risk_fn = jax.jit(self.model.risk)
+        risk = self._risk_fn(params, feat, nbrs, mask)
         return np.asarray(risk)[: graph.n_devices]
 
 

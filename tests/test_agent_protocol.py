@@ -333,11 +333,11 @@ async def _ws_try_connect(port: int, path: str, headers: str = ""):
 def test_websocket_auth_and_duplicate_rejection(run):
     """An unauthenticated peer must not occupy a session slot (the
     registry routes command downlink by client id, and ids are printed
-    in QR labels). Duplicate ids: with auth configured, a peer that
-    PROVES ownership replaces the stale session (a device rebooting
-    after an unclean disconnect must be able to reconnect — there is no
-    server-side ping to reap dead sockets); without auth, a duplicate
-    is rejected (409) because ownership can't be proven."""
+    in QR labels). Duplicate ids REPLACE the existing session (MQTT
+    CONNECT takeover semantics): a device rebooting after an unclean
+    disconnect must be able to reconnect — there is no server-side ping
+    to reap dead sockets — and with auth on the newcomer proved
+    ownership, so hijack requires the token."""
 
     async def main():
         from sitewhere_tpu.services.websocket import WebSocketListener
@@ -404,7 +404,8 @@ def test_websocket_auth_and_duplicate_rejection(run):
         finally:
             await listener.stop()
 
-        # WITHOUT auth there is no ownership proof: duplicate → 409
+        # open mode (loopback/test): takeover applies too — a 409 would
+        # hand any peer a lockout primitive without adding protection
         open_listener = WebSocketListener(on_message)
         await open_listener.start()
         try:
@@ -414,8 +415,8 @@ def test_websocket_auth_and_duplicate_rejection(run):
             first = open_listener.sessions["dev-9"]
             status, _, w2 = await _ws_try_connect(open_listener.port,
                                                   "/ws/dev-9")
-            assert "409" in status
-            assert open_listener.sessions["dev-9"] is first
+            assert "101" in status
+            assert open_listener.sessions["dev-9"] is not first
             w1.close()
             w2.close()
         finally:

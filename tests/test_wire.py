@@ -318,3 +318,53 @@ def test_api_server_blocks_private_sub_accessor(run):
                 {"identifier": "svc", "method": "_private"})
 
     run(main())
+
+
+def test_wire_shared_secret_auth(run):
+    """With a secret configured, the broker serves only peers whose
+    FIRST frame authenticates; wrong/missing secrets are cut off. The
+    client handshakes transparently, so the whole RemoteEventBus
+    surface works unchanged over an authed broker."""
+
+    async def main():
+        from sitewhere_tpu.kernel.wire import (
+            BusServer,
+            RemoteEventBus,
+            WireClient,
+        )
+
+        backing = EventBus(default_partitions=2)
+        await backing.initialize()
+        await backing.start()
+        server = BusServer(backing, secret="s3cret")
+        await server.start()
+        try:
+            # right secret: full surface works
+            remote = RemoteEventBus("127.0.0.1", server.port,
+                                    secret="s3cret")
+            await remote.initialize()
+            await remote.produce("t", {"x": 1}, key="k")
+            c = remote.subscribe("t", group="g")
+            records = await c.poll(max_records=10, timeout=5.0)
+            assert [r.value for r in records] == [{"x": 1}]
+            await remote.stop()
+
+            # wrong secret: the handshake call itself fails
+            bad = WireClient("127.0.0.1", server.port, secret="nope")
+            import pytest
+
+            with pytest.raises((RuntimeError, ConnectionError)):
+                await bad.connect()
+            bad.close()
+
+            # no secret at all: first (non-auth) op is rejected/cut off
+            anon = WireClient("127.0.0.1", server.port)
+            await anon.connect()
+            with pytest.raises((RuntimeError, ConnectionError)):
+                await asyncio.wait_for(anon.call("topic_names"), 5.0)
+            anon.close()
+        finally:
+            await server.stop()
+            await backing.stop()
+
+    run(main())
