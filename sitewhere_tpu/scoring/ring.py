@@ -35,9 +35,12 @@ class DeviceRing:
     on `device` (default backend device)."""
 
     def __init__(self, window: int, capacity: int = 1024,
-                 initial_floor: int = 1024):
+                 initial_floor: int = 1024, score_dtype=None):
         self.window = int(window)
         self.capacity = grow_pow2(int(capacity), floor=initial_floor)
+        # narrow flush-path score readback (float16 halves the only
+        # per-event device→host payload); settle upcasts on assignment
+        self.score_dtype = jnp.dtype(score_dtype) if score_dtype else None
         self._update_score_fns: dict[tuple, Callable] = {}
         self.faulted = False  # True after a failed dispatch donated state away
         self._alloc(self.capacity)
@@ -88,6 +91,7 @@ class DeviceRing:
 
     def _build_update_score(self, model, cap: int, bucket: int) -> Callable:
         w = self.window
+        out_dtype = self.score_dtype
 
         def step(params, vals, cnt, cur, dev, v):
             pos = cur[dev]
@@ -97,7 +101,10 @@ class DeviceRing:
             idx = (cur[dev][:, None] - w + jnp.arange(w)[None, :]) % w
             x = vals[dev[:, None], idx]
             valid = jnp.arange(w)[None, :] >= (w - cnt[dev])[:, None]
-            return vals, cnt, cur, model.score(params, x, valid)
+            scores = model.score(params, x, valid)
+            if out_dtype is not None:
+                scores = scores.astype(out_dtype)
+            return vals, cnt, cur, scores
 
         return jax.jit(step, donate_argnums=(1, 2, 3))
 
@@ -157,13 +164,14 @@ class StackedDeviceRing:
     """
 
     def __init__(self, window: int, n_tenants: int, device_cap: int = 1024,
-                 mesh=None):
+                 mesh=None, score_dtype=None):
         from sitewhere_tpu.parallel.mesh import tenant_placer
 
         self.window = int(window)
         self.mesh = mesh
         self.t_cap = int(n_tenants)
         self.device_cap = grow_pow2(int(device_cap), floor=1024)
+        self.score_dtype = jnp.dtype(score_dtype) if score_dtype else None
         self._fns: dict[tuple, Callable] = {}
         self.faulted = False
         self._place = tenant_placer(mesh)
@@ -219,6 +227,7 @@ class StackedDeviceRing:
 
     def _build_score(self, model) -> Callable:
         w = self.window
+        out_dtype = self.score_dtype
 
         def tenant_step(params, vals, cnt, cur, dev, v):
             pos = cur[dev]
@@ -228,7 +237,10 @@ class StackedDeviceRing:
             idx = (cur[dev][:, None] - w + jnp.arange(w)[None, :]) % w
             x = vals[dev[:, None], idx]
             valid = jnp.arange(w)[None, :] >= (w - cnt[dev])[:, None]
-            return vals, cnt, cur, model.score(params, x, valid)
+            scores = model.score(params, x, valid)
+            if out_dtype is not None:
+                scores = scores.astype(out_dtype)
+            return vals, cnt, cur, scores
 
         return jax.jit(jax.vmap(tenant_step), donate_argnums=(1, 2, 3))
 

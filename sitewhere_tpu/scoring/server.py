@@ -66,6 +66,12 @@ class ScoringConfig:
     # keeps the pipeline fed through settle jitter without letting an
     # overload build a 100 ms queue (the old 16× did).
     backlog_cap: int = 0
+    # flush-path score readback dtype: the [bucket] score vector is the
+    # only per-event device→host payload, and over a tunneled chip D2H
+    # bytes are the scarce resource — float16 halves them (z-like scores
+    # need ~3 significant digits; settle upcasts into its float32 result
+    # array). "float32" restores exact readback for golden-number work.
+    score_dtype: str = "float16"
 
     @property
     def backlog_events(self) -> int:
@@ -139,10 +145,12 @@ class ScoringSession:
         if getattr(self.model, "streaming", False):
             from sitewhere_tpu.scoring.stream import StreamingRing
 
-            ring = StreamingRing(self.model, capacity=capacity)
+            ring = StreamingRing(self.model, capacity=capacity,
+                                 score_dtype=self.cfg.score_dtype)
             ring.bind_params(self.params)
             return ring
-        return DeviceRing(self.model.cfg.window, capacity=capacity)
+        return DeviceRing(self.model.cfg.window, capacity=capacity,
+                          score_dtype=self.cfg.score_dtype)
 
     # -- warmup / params ---------------------------------------------------
 

@@ -34,14 +34,22 @@ import numpy as np
 from sitewhere_tpu.utils import grow_pow2
 
 
-def streaming_step(model) -> Callable:
+def streaming_step(model, out_dtype=None) -> Callable:
     """The fused gather→step_score→scatter step body, shared by the
     dedicated ring (jit) and the stacked ring (jit∘vmap) so the two hot
-    paths cannot diverge."""
+    paths cannot diverge.
+
+    `out_dtype` narrows the returned scores at the jit boundary (model
+    state stays float32): over a tunneled chip the device→host readback
+    is the scarce resource, and float16 scores halve the only per-event
+    payload the hot path ships back. Settle upcasts on assignment into
+    its float32 result array."""
 
     def step(params, state, dev, v):
         rows = jax.tree.map(lambda leaf: leaf[dev], state)
         scores, new_rows = model.step_score(params, rows, v)
+        if out_dtype is not None:
+            scores = scores.astype(out_dtype)
 
         def scatter(leaf, rows_new):
             return leaf.at[dev].set(rows_new, mode="drop")
@@ -56,10 +64,11 @@ class StreamingRing:
     plus one scratch row (index `capacity`) that absorbs padding."""
 
     def __init__(self, model, capacity: int = 1024,
-                 initial_floor: int = 1024):
+                 initial_floor: int = 1024, score_dtype=None):
         self.model = model
         self.window = int(model.cfg.window)  # load()-contract width
         self.capacity = grow_pow2(int(capacity), floor=initial_floor)
+        self.score_dtype = jnp.dtype(score_dtype) if score_dtype else None
         self._fns: dict[tuple, Callable] = {}
         self.faulted = False
         self.state = jax.device_put(model.init_state(self.capacity + 1))
@@ -109,7 +118,8 @@ class StreamingRing:
     # -- compiled step -----------------------------------------------------
 
     def _build_step(self, cap: int, bucket: int) -> Callable:
-        return jax.jit(streaming_step(self.model), donate_argnums=(1,))
+        return jax.jit(streaming_step(self.model, self.score_dtype),
+                       donate_argnums=(1,))
 
     def _pad(self, dev: np.ndarray, v: np.ndarray,
              bucket: int) -> tuple[np.ndarray, np.ndarray]:
@@ -165,12 +175,13 @@ class StackedStreamingRing:
     """
 
     def __init__(self, model, n_tenants: int, device_cap: int = 1024,
-                 mesh=None):
+                 mesh=None, score_dtype=None):
         from sitewhere_tpu.parallel.mesh import tenant_placer
 
         self.model = model
         self.window = int(model.cfg.window)
         self.mesh = mesh
+        self.score_dtype = jnp.dtype(score_dtype) if score_dtype else None
         self.t_cap = int(n_tenants)
         self.device_cap = grow_pow2(int(device_cap), floor=1024)
         self._fns: dict[tuple, Callable] = {}
@@ -249,7 +260,7 @@ class StackedStreamingRing:
     # -- compiled step -----------------------------------------------------
 
     def _build_step(self) -> Callable:
-        return jax.jit(jax.vmap(streaming_step(self.model)),
+        return jax.jit(jax.vmap(streaming_step(self.model, self.score_dtype)),
                        donate_argnums=(1,))
 
     def update_and_score(self, model, stacked_params, dev: np.ndarray,

@@ -215,3 +215,112 @@ class CoapListener(asyncio.DatagramProtocol):
 
     def error_received(self, exc) -> None:  # pragma: no cover - OS-dependent
         logger.debug("coap transport error: %s", exc)
+
+
+# -- client side (command delivery downlink) ---------------------------------
+
+
+def _encode_option(number_delta: int, value: bytes) -> bytes:
+    """One option with extended delta/length nibbles (§3.1)."""
+    out = bytearray()
+
+    def nibble(v: int) -> tuple[int, bytes]:
+        if v < 13:
+            return v, b""
+        if v < 269:
+            return 13, bytes([v - 13])
+        return 14, (v - 269).to_bytes(2, "big")
+
+    dn, dext = nibble(number_delta)
+    ln, lext = nibble(len(value))
+    out.append((dn << 4) | ln)
+    out += dext + lext + value
+    return bytes(out)
+
+
+def build_request(code: int, mid: int, token: bytes, path: str,
+                  payload: bytes, mtype: int = TYPE_CON) -> bytes:
+    out = bytearray([(1 << 6) | (mtype << 4) | len(token), code])
+    out += mid.to_bytes(2, "big")
+    out += token
+    number = 0
+    for seg in path.split("/"):
+        out += _encode_option(OPT_URI_PATH - number, seg.encode())
+        number = OPT_URI_PATH
+    if payload:
+        out += b"\xff" + payload
+    return bytes(out)
+
+
+class _CoapClientProtocol(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.replies: asyncio.Queue = asyncio.Queue()
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.replies.put_nowait(data)
+
+
+_mid_counter = [0]
+
+
+async def coap_post(host: str, port: int, path: str, payload: bytes,
+                    ack_timeout: float = 2.0, max_retransmit: int = 4,
+                    confirmable: bool = True) -> int:
+    """POST `payload` to coap://host:port/<path>; returns the response
+    code (e.g. 0x44 = 2.04). CON requests retransmit with exponential
+    backoff per §4.2 (ACK_TIMEOUT doubling, MAX_RETRANSMIT attempts);
+    raises TimeoutError when the exchange never completes. NON requests
+    are fire-and-forget (returns CODE_EMPTY)."""
+    loop = asyncio.get_running_loop()
+    transport, proto = await loop.create_datagram_endpoint(
+        _CoapClientProtocol, remote_addr=(host, port))
+    try:
+        _mid_counter[0] = (_mid_counter[0] + 1) % 0x10000
+        mid = _mid_counter[0]
+        token = mid.to_bytes(2, "big")
+        msg = build_request(CODE_POST, mid, token, path, payload,
+                            mtype=TYPE_CON if confirmable else TYPE_NON)
+        if not confirmable:
+            transport.sendto(msg)
+            return CODE_EMPTY
+        timeout = ack_timeout
+        acked = False  # empty ACK received: response comes separately
+        for _attempt in range(max_retransmit + 1):
+            if not acked:
+                transport.sendto(msg)
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    data = await asyncio.wait_for(proto.replies.get(),
+                                                  remaining)
+                except asyncio.TimeoutError:
+                    break
+                try:
+                    mtype, code, rmid, rtoken, _, _ = parse_message(data)
+                except (ValueError, IndexError):
+                    continue
+                if mtype == TYPE_RST and rmid == mid:
+                    raise ConnectionResetError("coap: peer RST")
+                if mtype == TYPE_ACK and rmid == mid:
+                    if code != CODE_EMPTY:
+                        return code   # piggybacked response
+                    # §5.2.2 separate response: the server ACKed the
+                    # request empty and will answer in its own CON/NON
+                    # exchange, matched by TOKEN; stop retransmitting,
+                    # keep the full remaining time budget listening
+                    acked = True
+                elif rtoken == token and code != CODE_EMPTY:
+                    # the separate response itself; ACK a CON back
+                    if mtype == TYPE_CON:
+                        transport.sendto(build_message(
+                            TYPE_ACK, CODE_EMPTY, rmid))
+                    return code
+            timeout *= 2  # §4.2 binary exponential backoff
+        raise TimeoutError(f"coap: no {'response' if acked else 'ACK'} "
+                           f"from {host}:{port} after "
+                           f"{max_retransmit + 1} attempts")
+    finally:
+        transport.close()

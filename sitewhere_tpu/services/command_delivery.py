@@ -158,6 +158,38 @@ class WebSocketDeliveryProvider:
         return await listener.send(device.token, payload)
 
 
+class CoapDeliveryProvider:
+    """Deliver commands to a device's own CoAP server (reference:
+    the CoAP command-delivery provider beside MQTT/SMS [SURVEY.md §2.2
+    command-delivery]): a confirmable POST to
+    coap://<coap_host>:<coap_port>/<path> recorded in device metadata,
+    with RFC 7252 retransmission; delivery succeeds on any 2.xx."""
+
+    def __init__(self, path: str = "commands", ack_timeout: float = 2.0,
+                 max_retransmit: int = 2):
+        self.path = path
+        self.ack_timeout = ack_timeout
+        self.max_retransmit = max_retransmit
+
+    async def deliver(self, device: Device, payload: bytes) -> bool:
+        from sitewhere_tpu.services.coap import coap_post
+
+        host = device.metadata.get("coap_host")
+        port = device.metadata.get("coap_port")
+        if not host or not port:
+            return False
+        try:
+            code = await coap_post(
+                host, int(port), self.path, payload,
+                ack_timeout=self.ack_timeout,
+                max_retransmit=self.max_retransmit)
+        except (TimeoutError, ConnectionResetError, OSError) as exc:
+            logger.warning("coap delivery to %s failed: %s",
+                           device.token, exc)
+            return False
+        return 0x40 <= code < 0x60  # 2.xx
+
+
 class CommandDeliveryEngine(TenantEngine):
     def __init__(self, service: "CommandDeliveryService", tenant: TenantConfig):
         super().__init__(service, tenant)
@@ -172,7 +204,11 @@ class CommandDeliveryEngine(TenantEngine):
                 topic_prefix=cfg.get("mqtt_topic_prefix", "swx/commands/")),
             "websocket": WebSocketDeliveryProvider(
                 self.runtime, self.tenant_id,
-                receiver_name=cfg.get("websocket_receiver", "websocket"))}
+                receiver_name=cfg.get("websocket_receiver", "websocket")),
+            "coap": CoapDeliveryProvider(
+                path=cfg.get("coap_path", "commands"),
+                ack_timeout=cfg.get("coap_ack_timeout", 2.0),
+                max_retransmit=cfg.get("coap_max_retransmit", 2))}
         self.default_encoder = cfg.get("encoder", "json")
         self.default_provider = cfg.get("provider", "queue")
         self.routes: dict[str, dict] = cfg.get("routes", {})

@@ -388,6 +388,52 @@ class CoapEventReceiver(BackgroundTaskComponent):
         await self.listener.stop()
 
 
+class AmqpEventReceiver(BackgroundTaskComponent):
+    """AMQP 0-9-1 ingest endpoint (reference analog: the RabbitMQ
+    inbound receiver): hosts a minimal AMQP server (services/amqp.py) —
+    any standard client (pika, amqplib, gateway SDKs) can connect, open
+    a channel and `basic.publish` SWB1/JSON payloads; confirm-mode
+    publishers get `basic.ack` (at-least-once). The routing key becomes
+    the batch source. `users: {username: password}` enables PLAIN auth
+    (unauthenticated connections are refused with 403)."""
+
+    def __init__(self, name: str, engine: "EventSourcesEngine",
+                 decoder: EventDecoder, host: str = "127.0.0.1",
+                 port: int = 0, users: Optional[dict] = None):
+        super().__init__(name)
+        self.engine = engine
+        self.decoder = decoder
+        self.users = dict(users) if users else None
+        from sitewhere_tpu.services.amqp import AmqpListener
+
+        self.listener = AmqpListener(
+            self._on_message, host=host, port=port,
+            authenticate=self._authenticate if self.users else None)
+
+    def _authenticate(self, username: str, password: str) -> bool:
+        return self.users.get(username) == password
+
+    @property
+    def port(self) -> int:
+        return self.listener.port
+
+    async def _on_message(self, routing_key: str, payload: bytes,
+                          source: str) -> None:
+        await self.engine.process_payload(
+            payload, f"{self.name}:{routing_key}", self.decoder,
+            ingest_monotonic=time.monotonic())
+
+    async def _do_start(self, monitor) -> None:
+        await self.listener.start()
+
+    async def _run(self) -> None:  # server runs itself
+        await asyncio.Event().wait()
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        await self.listener.stop()
+
+
 class EventSourcesEngine(TenantEngine):
     """Per-tenant receiver fleet + decode → decoded-events topic."""
 
@@ -448,6 +494,11 @@ class EventSourcesEngine(TenantEngine):
                                   host=cfg.get("host", "127.0.0.1"),
                                   port=cfg.get("port", 0),
                                   path=cfg.get("path", "telemetry"))
+        elif kind == "amqp":
+            r = AmqpEventReceiver(name, self, decoder,
+                                  host=cfg.get("host", "127.0.0.1"),
+                                  port=cfg.get("port", 0),
+                                  users=cfg.get("users"))
         else:
             raise ValueError(f"unknown receiver kind {kind!r}")
         self.receivers.append(r)

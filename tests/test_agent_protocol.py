@@ -600,3 +600,297 @@ def test_coap_malformed_fuzz_and_error_codes(run):
             await listener.stop()
 
     run(main())
+
+
+# -- AMQP 0-9-1 --------------------------------------------------------------
+
+
+def _amqp_frame(ftype: int, channel: int, payload: bytes) -> bytes:
+    return struct.pack(">BHI", ftype, channel, len(payload)) + payload + b"\xce"
+
+
+def _amqp_method(class_id: int, method_id: int, args: bytes = b"") -> bytes:
+    return struct.pack(">HH", class_id, method_id) + args
+
+
+def _amqp_ss(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _amqp_ls(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+async def _amqp_read_frame(reader) -> tuple[int, int, bytes]:
+    head = await asyncio.wait_for(reader.readexactly(7), 5.0)
+    ftype, channel, size = struct.unpack(">BHI", head)
+    payload = await asyncio.wait_for(reader.readexactly(size + 1), 5.0)
+    assert payload[-1] == 0xCE
+    return ftype, channel, payload[:-1]
+
+
+async def _amqp_expect(reader, class_id: int, method_id: int) -> bytes:
+    """Read method frames (skipping heartbeats) until the expected one."""
+    while True:
+        ftype, _, payload = await _amqp_read_frame(reader)
+        if ftype == 8:
+            continue
+        got = struct.unpack_from(">HH", payload, 0)
+        assert got == (class_id, method_id), f"got {got}"
+        return payload[4:]
+
+
+async def _amqp_connect(port: int, user: str = "gw",
+                        password: str = "pw"):
+    """Client-side 0-9-1 connection + channel-1 open."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(b"AMQP\x00\x00\x09\x01")
+    await _amqp_expect(reader, 10, 10)                      # start
+    plain = b"\x00" + user.encode() + b"\x00" + password.encode()
+    writer.write(_amqp_frame(1, 0, _amqp_method(
+        10, 11, struct.pack(">I", 0) + _amqp_ss("PLAIN")
+        + _amqp_ls(plain) + _amqp_ss("en_US"))))
+    ftype, _, payload = await _amqp_read_frame(reader)
+    class_id, method_id = struct.unpack_from(">HH", payload, 0)
+    if (class_id, method_id) == (10, 50):                   # close (403)
+        code = struct.unpack_from(">H", payload, 4)[0]
+        writer.close()
+        raise PermissionError(f"refused: {code}")
+    assert (class_id, method_id) == (10, 30)                # tune
+    writer.write(_amqp_frame(1, 0, _amqp_method(
+        10, 31, struct.pack(">HIH", 0, 131072, 0))))        # tune-ok
+    writer.write(_amqp_frame(1, 0, _amqp_method(
+        10, 40, _amqp_ss("/") + _amqp_ss("") + b"\x00")))   # open
+    await _amqp_expect(reader, 10, 41)                      # open-ok
+    writer.write(_amqp_frame(1, 1, _amqp_method(20, 10, _amqp_ss(""))))
+    await _amqp_expect(reader, 20, 11)                      # channel.open-ok
+    return reader, writer
+
+
+def _amqp_publish_frames(routing_key: str, body: bytes,
+                         channel: int = 1) -> bytes:
+    publish = _amqp_method(60, 40, struct.pack(">H", 0) + _amqp_ss("")
+                           + _amqp_ss(routing_key) + b"\x00")
+    header = struct.pack(">HHQH", 60, 0, len(body), 0)
+    return (_amqp_frame(1, channel, publish)
+            + _amqp_frame(2, channel, header)
+            + _amqp_frame(3, channel, body))
+
+
+def test_amqp_ingest_scores_anomaly_with_confirms(run):
+    """e2e: SWB1 telemetry published over AMQP 0-9-1 (confirm mode) is
+    basic.ack'd, decoded, persisted, and scored into an anomaly alert;
+    queue.declare bookkeeping and multi-frame bodies work."""
+
+    async def main():
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "amqp", "decoder": "swb1", "name": "amqp",
+                 "users": {"gw": "pw"}}]},
+            "rule-processing": {"model": "zscore",
+                                "model_config": {"window": 16},
+                                "threshold": 5.0, "batch_window_ms": 1.0},
+        }
+        async with running_pipeline(num_devices=20,
+                                    sections=sections) as rt:
+            sim = DeviceSimulator(SimConfig(num_devices=20, seed=9),
+                                  tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme") \
+                .receiver("default")
+            for k in range(20):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 400)
+
+            amqp = rt.api("event-sources").engine("acme").receiver("amqp")
+            reader, writer = await _amqp_connect(amqp.port)
+            # declare-before-publish bookkeeping is acked
+            writer.write(_amqp_frame(1, 1, _amqp_method(
+                50, 10, struct.pack(">H", 0) + _amqp_ss("telemetry")
+                + b"\x00" + struct.pack(">I", 0))))
+            await _amqp_expect(reader, 50, 11)              # declare-ok
+            writer.write(_amqp_frame(1, 1, _amqp_method(85, 10, b"\x00")))
+            await _amqp_expect(reader, 85, 11)              # confirm select-ok
+
+            sim.cfg = SimConfig(num_devices=20, seed=9, anomaly_rate=1.0,
+                                anomaly_magnitude=20.0)
+            payload, truth = sim.payload(t=21 * 60.0)
+            assert truth.all()
+            writer.write(_amqp_publish_frames("telemetry", payload))
+            args = await _amqp_expect(reader, 60, 80)       # basic.ack
+            assert struct.unpack_from(">Q", args, 0)[0] == 1
+
+            await wait_until(
+                lambda: em.telemetry.total_events == 420, timeout=10.0)
+            await wait_until(
+                lambda: any(a.event_date == 21 * 60.0
+                            for a in em.list_alerts()), timeout=15.0)
+
+            # multi-frame body: split a second payload across two body
+            # frames under one content header
+            payload2, _ = sim.payload(t=22 * 60.0)
+            publish = _amqp_method(60, 40, struct.pack(">H", 0)
+                                   + _amqp_ss("") + _amqp_ss("telemetry")
+                                   + b"\x00")
+            header = struct.pack(">HHQH", 60, 0, len(payload2), 0)
+            mid = len(payload2) // 2
+            writer.write(_amqp_frame(1, 1, publish)
+                         + _amqp_frame(2, 1, header)
+                         + _amqp_frame(3, 1, payload2[:mid])
+                         + _amqp_frame(3, 1, payload2[mid:]))
+            args = await _amqp_expect(reader, 60, 80)
+            assert struct.unpack_from(">Q", args, 0)[0] == 2
+            await wait_until(
+                lambda: em.telemetry.total_events == 440, timeout=10.0)
+
+            # clean close
+            writer.write(_amqp_frame(1, 0, _amqp_method(
+                10, 50, struct.pack(">H", 200) + _amqp_ss("bye")
+                + struct.pack(">HH", 0, 0))))
+            await _amqp_expect(reader, 10, 51)              # close-ok
+            writer.close()
+
+    run(main())
+
+
+def test_amqp_auth_and_consume_refusal(run):
+    """Wrong PLAIN credentials are refused with connection.close 403;
+    basic.consume on an authenticated connection gets channel.close 540
+    (ingest endpoint, not a broker); a bad protocol header is answered
+    with the supported version."""
+
+    async def main():
+        from sitewhere_tpu.services.amqp import AmqpListener
+
+        got = []
+
+        async def on_message(key, body, source):
+            got.append((key, body, source))
+
+        listener = AmqpListener(
+            on_message, authenticate=lambda u, p: (u, p) == ("gw", "pw"))
+        await listener.start()
+        try:
+            # wrong password → PermissionError from the close frame
+            try:
+                await _amqp_connect(listener.port, "gw", "nope")
+                raise AssertionError("expected refusal")
+            except PermissionError as exc:
+                assert "403" in str(exc)
+
+            # right creds, then basic.consume → channel.close 540
+            reader, writer = await _amqp_connect(listener.port)
+            writer.write(_amqp_frame(1, 1, _amqp_method(
+                60, 20, struct.pack(">H", 0) + _amqp_ss("q")
+                + _amqp_ss("tag") + b"\x00" + struct.pack(">I", 0))))
+            args = await _amqp_expect(reader, 20, 40)       # channel.close
+            assert struct.unpack_from(">H", args, 0)[0] == 540
+            # the connection survives; a reopened channel still publishes
+            writer.write(_amqp_frame(1, 1, _amqp_method(20, 10,
+                                                        _amqp_ss(""))))
+            await _amqp_expect(reader, 20, 11)
+            writer.write(_amqp_publish_frames("k", b"payload"))
+            await wait_until(lambda: len(got) == 1, timeout=5.0)
+            assert got[0] == ("k", b"payload", "gw")
+            writer.close()
+
+            # bad protocol header → server replies with its version
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", listener.port)
+            writer.write(b"HTTP/1.1 GET /\r\n")
+            reply = await asyncio.wait_for(reader.read(8), 5.0)
+            assert reply == b"AMQP\x00\x00\x09\x01"
+            writer.close()
+        finally:
+            await listener.stop()
+
+    run(main())
+
+
+def test_amqp_oversize_body_closes_channel_not_connection(run):
+    """A publish whose declared body exceeds max_body gets channel.close
+    311 while its in-flight body frames are swallowed — the connection
+    (and a reopened channel) keeps working."""
+
+    async def main():
+        from sitewhere_tpu.services.amqp import AmqpListener
+
+        got = []
+
+        async def on_message(key, body, source):
+            got.append(body)
+
+        listener = AmqpListener(on_message, max_body=64)
+        await listener.start()
+        try:
+            reader, writer = await _amqp_connect(listener.port)
+            big = b"z" * 200
+            writer.write(_amqp_publish_frames("k", big))
+            args = await _amqp_expect(reader, 20, 40)       # channel.close
+            assert struct.unpack_from(">H", args, 0)[0] == 311
+            writer.write(_amqp_frame(1, 1, _amqp_method(20, 41)))  # close-ok
+            # connection survives: reopen the channel, publish small
+            writer.write(_amqp_frame(1, 1, _amqp_method(20, 10,
+                                                        _amqp_ss(""))))
+            await _amqp_expect(reader, 20, 11)
+            writer.write(_amqp_publish_frames("k", b"small"))
+            await wait_until(lambda: got == [b"small"], timeout=5.0)
+            writer.close()
+        finally:
+            await listener.stop()
+
+    run(main())
+
+
+def test_coap_client_separate_response(run):
+    """coap_post handles RFC 7252 §5.2.2 separate responses: an empty
+    ACK stops retransmission, the later CON response (matched by token)
+    is the result and gets ACKed back."""
+
+    async def main():
+        from sitewhere_tpu.services.coap import (
+            CODE_CHANGED, CODE_EMPTY, TYPE_ACK, TYPE_CON,
+            build_message, coap_post, parse_message)
+
+        acks_seen = []
+
+        class SlowServer(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                mtype, code, mid, token, _, _ = parse_message(data)
+                if mtype == TYPE_ACK:
+                    acks_seen.append(mid)
+                    return
+                # empty ACK now, separate CON response shortly after
+                self.transport.sendto(
+                    build_message(TYPE_ACK, CODE_EMPTY, mid), addr)
+
+                async def later():
+                    await asyncio.sleep(0.15)
+                    # response CON: echo token, fresh mid
+                    out = bytearray(build_message(
+                        TYPE_CON, CODE_CHANGED, 0x7777))
+                    out[0] |= len(token)
+                    out[4:4] = token
+                    self.transport.sendto(bytes(out), addr)
+
+                asyncio.get_running_loop().create_task(later())
+
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            SlowServer, local_addr=("127.0.0.1", 0))
+        port = transport.get_extra_info("sockname")[1]
+        try:
+            code = await coap_post("127.0.0.1", port, "commands", b"x",
+                                   ack_timeout=0.5)
+            assert code == CODE_CHANGED
+            # our client ACKed the separate CON response
+            await wait_until(lambda: 0x7777 in acks_seen, timeout=5.0)
+        finally:
+            transport.close()
+
+    run(main())

@@ -464,3 +464,73 @@ def test_webhook_dead_letters_on_exhausted_retries(run):
         assert len(records[0].value) == len(batch)  # the record, intact
 
     run(main())
+
+
+def test_coap_command_delivery_with_retransmit(run):
+    """Commands route to a device's own CoAP server (metadata
+    coap_host/coap_port): a confirmable POST lands on /commands; a
+    device that drops the first CON still receives it via RFC 7252
+    retransmission; a device with no CoAP endpoint fails delivery and
+    the invocation lands on the undelivered topic."""
+
+    async def main():
+        from sitewhere_tpu.kernel.bus import TopicNaming
+        from sitewhere_tpu.services.coap import CoapListener
+
+        sections = {"command-delivery": {"provider": "coap",
+                                         "coap_ack_timeout": 0.2}}
+        async with full_instance(sections) as rt:
+            got: list[bytes] = []
+            drop_first = [True]
+
+            class LossyListener(CoapListener):
+                # device-side stand-in that loses the first datagram
+                def datagram_received(self, data, addr):
+                    if drop_first[0]:
+                        drop_first[0] = False
+                        return
+                    super().datagram_received(data, addr)
+
+            async def on_cmd(payload, source):
+                got.append(payload)
+
+            device_srv = LossyListener(on_cmd, path="commands")
+            await device_srv.start()
+
+            dm = rt.api("device-management").management("acme")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="ping", device_type_id=dt.id, name="ping"))
+            device = dm.get_device_by_token("dev-4")
+            import dataclasses
+            dm.update_device(dataclasses.replace(device, metadata={
+                "coap_host": "127.0.0.1",
+                "coap_port": str(device_srv.port)}))
+            assignment = dm.get_active_assignments_for_device(device.id)[0]
+
+            em = rt.api("event-management").management("acme")
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id, parameter_values={})])
+            await wait_until(lambda: got, timeout=10.0)
+            assert json.loads(got[0])["command"] == "ping"
+            assert drop_first[0] is False  # retransmission was exercised
+
+            # no CoAP endpoint in metadata → undelivered record
+            undelivered = rt.bus.subscribe(
+                rt.naming.tenant_topic(
+                    "acme", TopicNaming.UNDELIVERED_COMMANDS),
+                group="t-undelivered")
+            bare = dm.get_device_by_token("dev-5")
+            asn = dm.get_active_assignments_for_device(bare.id)[0]
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=bare.id, assignment_id=asn.id,
+                command_id=cmd.id, parameter_values={})])
+            await wait_until(
+                lambda: any(r.value.device_id == bare.id
+                            for r in undelivered.poll_nowait(
+                                max_records=16)), timeout=10.0)
+            undelivered.close()
+            await device_srv.stop()
+
+    run(main())

@@ -255,9 +255,12 @@ def test_pool_streaming_matches_dedicated_sessions(run):
                 got = delivered[tid][k]
                 order = np.argsort(got.device_index)
                 ref_order = np.argsort(expect[tid].device_index)
+                # pooled (vmap over the stack) vs dedicated flushes round
+                # to fp16 independently at readback (score_dtype default):
+                # one fp16 ulp at z≈8 is ~0.008, so parity holds to ~2e-2
                 np.testing.assert_allclose(
                     got.score[order], expect[tid].score[ref_order],
-                    atol=1e-4)
+                    atol=2e-2)
         for r in refs.values():
             r.close()
         pool.close()
