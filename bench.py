@@ -181,6 +181,7 @@ def run_supervised(args, argv: list) -> int:
     clearly-labeled CPU run — a measured CPU artifact beats a zero."""
     force_cpu = args.force_cpu
     fallback_note = None
+    cpu_extra_args: list = []
 
     def _cpu_fallback(reason: str) -> bool:
         nonlocal force_cpu, fallback_note
@@ -194,6 +195,15 @@ def run_supervised(args, argv: list) -> int:
             return False
         force_cpu = True
         fallback_note = f"cpu ({reason})"
+        # workload shape is ours to pick per platform: the TPU default
+        # fleet (16384 = one big flush per round) drowns a CPU backend
+        # in per-flush work — measured ~770k ev/s at 4096 vs ~430k at
+        # 16384 on this rig — so unless the caller pinned --devices,
+        # let the fallback run the CPU-shaped fleet
+        if not any(a == "--devices" or a.startswith("--devices=")
+                   for a in argv):
+            cpu_extra_args.append("--devices")
+            cpu_extra_args.append("4096")
         return True
 
     try:
@@ -207,14 +217,18 @@ def run_supervised(args, argv: list) -> int:
                 print(_error_artifact(
                     args, f"cpu probe failed: {exc}"))
             return 1
-    # generous inner bound: warmup compiles + both phases + drains + slack
+    # generous inner bound: warmup compiles + every saturation trial
+    # (window + drain + inter-trial quiesce) + latency phase + slack
     # (--train has no phase args bounding it: give it a flat hour)
+    n_trials = max(args.sat_trials, 1)
     inner_timeout = 3600.0 if args.train else (
-        args.ready_timeout + args.seconds
-        + args.latency_seconds + args.drain_timeout
-        + args.latency_drain_timeout + 300.0)
+        args.ready_timeout
+        + n_trials * (args.seconds + args.drain_timeout)
+        + (n_trials - 1) * args.drain_timeout  # quiesce bound per gap
+        + args.latency_seconds + args.latency_drain_timeout + 300.0)
     for attempt in (1, 2):
-        cmd = [sys.executable, os.path.abspath(__file__), "--inner", *argv]
+        cmd = [sys.executable, os.path.abspath(__file__), "--inner", *argv,
+               *cpu_extra_args]
         if force_cpu and "--force-cpu" not in argv:
             cmd.append("--force-cpu")
         last_line = None

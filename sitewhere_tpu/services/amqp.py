@@ -263,6 +263,10 @@ class _Conn:
             return
         if class_id == CHANNEL:
             if method_id == 10:        # open
+                # a reopened channel number must not inherit discard
+                # state from an aborted oversize publish that never sent
+                # its body frames
+                self.discard.pop(channel, None)
                 self.channels[channel] = {"confirm": False, "publishes": 0}
                 await self.send_method(channel, _method(
                     CHANNEL, 11, _longstr(b"")))
@@ -285,16 +289,18 @@ class _Conn:
             args.short()                                # reserved
             args.shortstr()                             # exchange name
             args.shortstr()                             # type
+            # bit order: passive|durable|auto-delete|internal|no-wait
             flags = args.octet()
-            if not flags & 0x04:                        # no-wait unset
+            if not flags & 0x10:                        # no-wait unset
                 await self.send_method(channel, _method(EXCHANGE, 11))
             return
         if class_id == QUEUE:
             if method_id == 10:                         # declare
                 args.short()
                 qname = args.shortstr() or "swx-ingest"
+                # bit order: passive|durable|exclusive|auto-delete|no-wait
                 flags = args.octet()
-                if not flags & 0x08:                    # no-wait unset
+                if not flags & 0x10:                    # no-wait unset
                     await self.send_method(channel, _method(
                         QUEUE, 11, _shortstr(qname)
                         + struct.pack(">II", 0, 0)))
@@ -307,6 +313,7 @@ class _Conn:
             return
         if class_id == CONFIRM and method_id == 10:     # select
             ch["confirm"] = True
+            ch["publishes"] = 0     # delivery tags restart at 1 (§confirms)
             if not (args.data[args.pos:args.pos + 1] or b"\0")[0] & 0x01:
                 await self.send_method(channel, _method(CONFIRM, 11))
             return

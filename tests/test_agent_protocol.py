@@ -894,3 +894,58 @@ def test_coap_client_separate_response(run):
             transport.close()
 
     run(main())
+
+
+def test_amqp_confirm_tags_nowait_and_aborted_oversize(run):
+    """Delivery tags restart at 1 after confirm.select (publishes made
+    before select don't count); a declare with the no-wait bit (0x10)
+    gets no declare-ok; an oversize publish ABORTED before its body
+    frames doesn't poison a reopened channel number."""
+
+    async def main():
+        from sitewhere_tpu.services.amqp import AmqpListener
+
+        got = []
+
+        async def on_message(key, body, source):
+            got.append(body)
+
+        listener = AmqpListener(on_message, max_body=64)
+        await listener.start()
+        try:
+            reader, writer = await _amqp_connect(listener.port)
+            # two publishes BEFORE confirm.select
+            writer.write(_amqp_publish_frames("k", b"one"))
+            writer.write(_amqp_publish_frames("k", b"two"))
+            await wait_until(lambda: len(got) == 2, timeout=5.0)
+            # no-wait declare: must NOT produce a declare-ok
+            writer.write(_amqp_frame(1, 1, _amqp_method(
+                50, 10, struct.pack(">H", 0) + _amqp_ss("q")
+                + b"\x10" + struct.pack(">I", 0))))
+            # select, then publish: ack tag must be 1, not 3
+            writer.write(_amqp_frame(1, 1, _amqp_method(85, 10, b"\x00")))
+            await _amqp_expect(reader, 85, 11)  # fails if declare-ok leaked
+            writer.write(_amqp_publish_frames("k", b"three"))
+            args = await _amqp_expect(reader, 60, 80)
+            assert struct.unpack_from(">Q", args, 0)[0] == 1
+
+            # oversize publish aborted BEFORE body frames: close-ok,
+            # reopen same channel, a fresh publish must still deliver
+            publish = _amqp_method(60, 40, struct.pack(">H", 0)
+                                   + _amqp_ss("") + _amqp_ss("k") + b"\x00")
+            header = struct.pack(">HHQH", 60, 0, 500, 0)  # > max_body
+            writer.write(_amqp_frame(1, 1, publish)
+                         + _amqp_frame(2, 1, header))
+            args = await _amqp_expect(reader, 20, 40)
+            assert struct.unpack_from(">H", args, 0)[0] == 311
+            writer.write(_amqp_frame(1, 1, _amqp_method(20, 41)))
+            writer.write(_amqp_frame(1, 1, _amqp_method(20, 10,
+                                                        _amqp_ss(""))))
+            await _amqp_expect(reader, 20, 11)
+            writer.write(_amqp_publish_frames("k", b"fresh"))
+            await wait_until(lambda: got[-1] == b"fresh", timeout=5.0)
+            writer.close()
+        finally:
+            await listener.stop()
+
+    run(main())
