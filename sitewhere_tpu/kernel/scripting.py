@@ -33,12 +33,21 @@ class Script:
 
 
 class ScriptManager:
-    """Per-tenant script store + compiler (reference: ScriptManager)."""
+    """Per-tenant script store + compiler (reference: ScriptManager).
+
+    `entrypoint`/`require_async` parameterize the contract per extension
+    surface: rule hooks are `async def process(event, api)` (the
+    default); event-source decoder scripts are `def decode(payload,
+    ctx)` (reference: GroovyEventDecoder beside the Groovy rule
+    scripts)."""
 
     ENTRYPOINT = "process"
 
-    def __init__(self, tenant_id: str):
+    def __init__(self, tenant_id: str, entrypoint: str = ENTRYPOINT,
+                 require_async: bool = True):
         self.tenant_id = tenant_id
+        self.entrypoint = entrypoint
+        self.require_async = require_async
         self.scripts: dict[str, Script] = {}
         self._compiled: dict[str, Callable] = {}
 
@@ -72,14 +81,19 @@ class ScriptManager:
         namespace: dict = {}
         code = compile(source, f"<script:{self.tenant_id}/{name}>", "exec")
         exec(code, namespace)  # noqa: S102 - operator-trusted extension surface
-        fn = namespace.get(self.ENTRYPOINT)
+        fn = namespace.get(self.entrypoint)
+        kind = "async def" if self.require_async else "def"
         if fn is None or not callable(fn):
             raise ValueError(
-                f"script {name!r} must define `async def {self.ENTRYPOINT}"
-                f"(event, api)`")
+                f"script {name!r} must define `{kind} {self.entrypoint}(...)`")
         import inspect
 
-        if not inspect.iscoroutinefunction(fn):
-            raise ValueError(f"script {name!r}: `{self.ENTRYPOINT}` must be "
+        if self.require_async and not inspect.iscoroutinefunction(fn):
+            raise ValueError(f"script {name!r}: `{self.entrypoint}` must be "
                              f"`async def`")
+        if not self.require_async and inspect.iscoroutinefunction(fn):
+            # contract errors surface at upload, not at first event: a
+            # sync surface calling an async fn would get a coroutine back
+            raise ValueError(f"script {name!r}: `{self.entrypoint}` must be "
+                             f"a plain `def`, not `async def`")
         return fn

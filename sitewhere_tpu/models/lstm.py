@@ -81,15 +81,43 @@ class LstmAnomalyModel:
         preds = (seq.astype(jnp.float32) @ head["w"] + head["b"])[..., 0]
         return preds                                       # [B, W-1]
 
+    def _finalize(self, pred_last: jax.Array, xn: jax.Array,
+                  valid: jax.Array) -> jax.Array:
+        """Shared scoring tail: |forecast error| at the newest step,
+        short-history gate, clip — one implementation so `score` and
+        `score_fused` cannot drift."""
+        err = jnp.abs(pred_last - xn[:, -1])
+        # rows with too little history can't be judged → score 0
+        enough = valid.sum(-1) >= max(8, self.cfg.window // 8)
+        return jnp.clip(jnp.where(enough, err, 0.0), 0.0, self.cfg.score_clip)
+
     def score(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
         """Anomaly score per row: normalized |forecast error| at the newest
         step. x: [B, W] raw values; valid: [B, W] bool. → [B] float32."""
         xn, _, _ = self._normalize(x, valid.astype(jnp.float32))
         preds = self._predictions(params, xn)
-        err = jnp.abs(preds[:, -1] - xn[:, -1])
-        # rows with too little history can't be judged → score 0
-        enough = valid.sum(-1) >= max(8, self.cfg.window // 8)
-        return jnp.clip(jnp.where(enough, err, 0.0), 0.0, self.cfg.score_clip)
+        return self._finalize(preds[:, -1], xn, valid)
+
+    def score_fused(self, params: dict, x: jax.Array,
+                    valid: jax.Array) -> jax.Array:
+        """`score` with the recurrence in the Pallas fused-window kernel
+        when eligible (single layer, tile-divisible batch, real TPU —
+        ops/lstm_kernel.py); identical semantics, reference fallback
+        otherwise. Scoring needs only the LAST step's prediction, so the
+        kernel keeps h/c + weights in VMEM across all W-1 steps and
+        writes back one [B, h] tensor. Used by the dedicated windowed
+        ring's flush jit (never under vmap — the stacked/pooled path
+        keeps `score`, whose lax.scan batches under vmap)."""
+        from sitewhere_tpu.ops.lstm_kernel import lstm_window_final, pallas_ok
+
+        cfg = self.cfg
+        if not pallas_ok(int(x.shape[0]), cfg.layers, cfg.compute_dtype):
+            return self.score(params, x, valid)
+        xn, _, _ = self._normalize(x, valid.astype(jnp.float32))
+        h = lstm_window_final(params["lstm0"], xn[:, :-1], cfg.compute_dtype)
+        head = params["head"]
+        pred = (h @ head["w"] + head["b"])[:, 0]
+        return self._finalize(pred, xn, valid)
 
     def flops_per_event(self) -> float:
         """Approximate forward FLOPs to score ONE event (one window row):

@@ -1,0 +1,9 @@
+"""TPU kernels (Pallas) for hot ops the XLA autofuser leaves on the
+table. Each kernel ships with a pure-jax reference path and an
+auto-selection helper; CPU/test runs always take the reference path
+(Pallas interpret mode is exercised by dedicated parity tests)."""
+
+from sitewhere_tpu.ops.lstm_kernel import (  # noqa: F401
+    lstm_window_final,
+    pallas_ok,
+)

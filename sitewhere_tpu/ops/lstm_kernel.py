@@ -1,0 +1,134 @@
+"""Pallas TPU kernel: fused windowed-LSTM recurrence → final hidden.
+
+Why this op: the windowed anomaly scorer re-runs a W-step LSTM over
+every flushed device window (models/lstm.py `_predictions`), and the
+measured ceiling of that path on a v5e chip was the scan itself —
+63 sequential cell steps whose per-step tensors ([B,64] state, [64,256]
+gates) bounce through HBM between XLA loop iterations, with matmuls too
+small to hide the traffic. Scoring only consumes the LAST step's
+prediction, so the kernel form is: keep h/c and both weight matrices
+resident in VMEM, run the whole recurrence in one kernel invocation per
+batch tile, and write back ONLY the final h — O(B·h) HBM writes instead
+of O(B·h·W) intermediate traffic. (Training still wants every step's
+output for the loss; it keeps the lax.scan path in models/common.py.)
+
+Semantics match `lstm_scan(params, xn[:, :-1, None], bf16)[1][0]`:
+bf16 matmuls (f32 accumulation — one rounding tighter than the scan
+path's bf16 matmul outputs), f32 gates/state, fused i/f/g/o gate layout
+from models/common.lstm_init.
+
+The pure-jax reference path (`_reference_final`) is the fallback for
+CPU runs, multi-layer configs, and batch sizes the tile doesn't divide;
+`pallas_ok()` is the auto-selection predicate. Parity is pinned by
+tests/test_pallas.py in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+B_TILE = 256          # batch rows per kernel program (f32 sublane-friendly)
+
+
+def _kernel(x_ref, wx_ref, wh_ref, b_ref, out_ref, h_scr, c_scr, *,
+            steps: int, hidden: int):
+    """One batch tile: run `steps` cell updates with everything in VMEM."""
+    from jax.experimental import pallas as pl
+
+    h_scr[...] = jnp.zeros_like(h_scr)
+    c_scr[...] = jnp.zeros_like(c_scr)
+
+    def step(t, carry):
+        xt = x_ref[:, pl.ds(t, 1)].astype(jnp.bfloat16)        # [Bt, 1]
+        gates = (
+            jnp.dot(xt, wx_ref[...],
+                    preferred_element_type=jnp.float32)
+            + jnp.dot(h_scr[...].astype(jnp.bfloat16), wh_ref[...],
+                      preferred_element_type=jnp.float32)
+            + b_ref[...])                                      # [Bt, 4h]
+        i = gates[:, :hidden]
+        f = gates[:, hidden:2 * hidden]
+        g = gates[:, 2 * hidden:3 * hidden]
+        o = gates[:, 3 * hidden:]
+        c = jax.nn.sigmoid(f) * c_scr[...] \
+            + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        h_scr[...] = h
+        c_scr[...] = c
+        return carry
+
+    jax.lax.fori_loop(0, steps, step, 0)
+    out_ref[...] = h_scr[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_final(xn, wx, wh, b, *, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T = xn.shape
+    hidden = wh.shape[0]
+    kernel = functools.partial(_kernel, steps=T, hidden=hidden)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // B_TILE,),
+        in_specs=[
+            pl.BlockSpec((B_TILE, T), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4 * hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, 4 * hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4 * hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((B_TILE, hidden), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, hidden), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((B_TILE, hidden), jnp.float32),
+            pltpu.VMEM((B_TILE, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xn, wx, wh, b)
+
+
+def _reference_final(params_layer: dict, xn: jax.Array, cdt) -> jax.Array:
+    """Pure-jax twin (models/common.lstm_scan, final h only)."""
+    from sitewhere_tpu.models.common import lstm_scan
+
+    _, (h, _c) = lstm_scan(params_layer, xn[:, :, None], cdt)
+    return h
+
+
+def pallas_ok(batch: int, layers: int, cdt=jnp.bfloat16) -> bool:
+    """Auto-selection: the kernel covers the single-layer bf16 scorer
+    on a real TPU backend for tile-divisible batches (bench buckets are
+    powers of two ≥ 256). Everything else — including a model built
+    with a non-bf16 compute_dtype, whose matmuls the kernel would
+    silently narrow — takes the reference path."""
+    return (layers == 1 and batch >= B_TILE and batch % B_TILE == 0
+            and cdt == jnp.bfloat16
+            and jax.default_backend() == "tpu")
+
+
+def lstm_window_final(params_layer: dict, xn: jax.Array, cdt,
+                      use_pallas: bool | None = None,
+                      interpret: bool = False) -> jax.Array:
+    """Final hidden state of a single-layer LSTM over xn[:, :T].
+
+    xn: [B, T] f32 normalized inputs (caller already dropped the last
+    window slot). `use_pallas=None` auto-selects via `pallas_ok`; the
+    kernel path computes bf16 matmuls, so non-bf16 `cdt` never selects
+    it."""
+    if use_pallas is None:
+        use_pallas = pallas_ok(xn.shape[0], layers=1, cdt=cdt)
+    if not use_pallas:
+        return _reference_final(params_layer, xn, cdt)
+    wx = params_layer["wx"].astype(jnp.bfloat16)
+    wh = params_layer["wh"].astype(jnp.bfloat16)
+    b = params_layer["b"].reshape(1, -1)
+    return _pallas_final(xn, wx, wh, b, interpret=interpret)

@@ -350,6 +350,13 @@ class RestServer(LifecycleComponent):
           AUTH_ADMIN_SCRIPTS)
         r("DELETE", r"/api/scripts/(?P<name>[^/]+)", self.delete_script,
           AUTH_ADMIN_SCRIPTS)
+        # decoder scripts (event-sources extension surface)
+        r("GET", r"/api/decoder-scripts", self.list_decoder_scripts,
+          AUTH_ADMIN_SCRIPTS)
+        r("PUT", r"/api/decoder-scripts/(?P<name>[^/]+)",
+          self.put_decoder_script, AUTH_ADMIN_SCRIPTS)
+        r("DELETE", r"/api/decoder-scripts/(?P<name>[^/]+)",
+          self.delete_decoder_script, AUTH_ADMIN_SCRIPTS)
         # labels
         r("GET", r"/api/labels/devices/(?P<token>[^/]+)", self.device_label)
 
@@ -776,27 +783,52 @@ class RestServer(LifecycleComponent):
 
     # -- handlers: scripts --------------------------------------------------
 
-    async def list_scripts(self, req: Request):
-        engine = self._engine(req, "rule-processing")
-        return [{"name": s.name, "version": s.version,
-                 "updatedAt": s.updated_at} for s in engine.scripts.list()]
+    # the two script surfaces (rule hooks on rule-processing, payload
+    # decoders on event-sources) share one handler set, parameterized by
+    # (service id, uploader, manager accessor)
 
-    async def put_script(self, req: Request):
-        engine = self._engine(req, "rule-processing")
+    def _script_list(self, req: Request, service: str, manager):
+        engine = self._engine(req, service)
+        return [{"name": s.name, "version": s.version,
+                 "updatedAt": s.updated_at} for s in manager(engine).list()]
+
+    def _script_put(self, req: Request, service: str, put):
+        engine = self._engine(req, service)
         b = req.json()
         if "source" not in b:
             raise HttpError(400, "source required")
         try:
-            script = engine.put_script(req.params["name"], b["source"])
+            script = put(engine)(req.params["name"], b["source"])
         except Exception as exc:  # noqa: BLE001 - module body runs at upload;
             # any exception there is the uploader's bug, not a server error
             raise HttpError(400, f"script error: {type(exc).__name__}: "
                                  f"{exc}") from exc
         return {"name": script.name, "version": script.version}
 
+    async def list_scripts(self, req: Request):
+        return self._script_list(req, "rule-processing",
+                                 lambda e: e.scripts)
+
+    async def put_script(self, req: Request):
+        return self._script_put(req, "rule-processing",
+                                lambda e: e.put_script)
+
     async def delete_script(self, req: Request):
         engine = self._engine(req, "rule-processing")
         engine.delete_script(req.params["name"])
+        return {"deleted": req.params["name"]}
+
+    async def list_decoder_scripts(self, req: Request):
+        return self._script_list(req, "event-sources",
+                                 lambda e: e.decoder_scripts)
+
+    async def put_decoder_script(self, req: Request):
+        return self._script_put(req, "event-sources",
+                                lambda e: e.put_decoder_script)
+
+    async def delete_decoder_script(self, req: Request):
+        engine = self._engine(req, "event-sources")
+        engine.decoder_scripts.delete(req.params["name"])
         return {"deleted": req.params["name"]}
 
     # -- handlers: device groups -------------------------------------------

@@ -92,6 +92,10 @@ class DeviceRing:
     def _build_update_score(self, model, cap: int, bucket: int) -> Callable:
         w = self.window
         out_dtype = self.score_dtype
+        # the dedicated ring is never vmapped, so it may take the
+        # model's fused (Pallas) scorer when one exists; the stacked
+        # ring stays on `score` (lax.scan batches under vmap)
+        score = getattr(model, "score_fused", model.score)
 
         def step(params, vals, cnt, cur, dev, v):
             pos = cur[dev]
@@ -101,7 +105,7 @@ class DeviceRing:
             idx = (cur[dev][:, None] - w + jnp.arange(w)[None, :]) % w
             x = vals[dev[:, None], idx]
             valid = jnp.arange(w)[None, :] >= (w - cnt[dev])[:, None]
-            scores = model.score(params, x, valid)
+            scores = score(params, x, valid)
             if out_dtype is not None:
                 scores = scores.astype(out_dtype)
             return vals, cnt, cur, scores

@@ -1,0 +1,111 @@
+"""Parity tests for the Pallas fused-window LSTM kernel
+(ops/lstm_kernel.py) in interpret mode — the kernel's math must match
+the lax.scan reference path it replaces on TPU.
+
+Interpret mode executes the kernel's memory/grid semantics in the
+Pallas interpreter on CPU, so these tests pin correctness everywhere;
+the real-TPU compile is exercised by `bench.py --model lstm` on the rig.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sitewhere_tpu.models.common import lstm_init, lstm_scan
+from sitewhere_tpu.models.lstm import LstmAnomalyModel, LstmConfig
+from sitewhere_tpu.ops.lstm_kernel import (
+    B_TILE,
+    _pallas_final,
+    lstm_window_final,
+    pallas_ok,
+)
+
+
+def _final_reference(params, xn, cdt):
+    _, (h, _) = lstm_scan(params, xn[:, :, None], cdt)
+    return h
+
+
+def test_kernel_matches_scan_reference_interpret():
+    rng = jax.random.PRNGKey(0)
+    p = lstm_init(rng, 1, 64)
+    xn = jax.random.normal(jax.random.PRNGKey(1), (2 * B_TILE, 63),
+                           jnp.float32)
+    got = _pallas_final(xn, p["wx"].astype(jnp.bfloat16),
+                        p["wh"].astype(jnp.bfloat16),
+                        p["b"].reshape(1, -1), interpret=True)
+    want = _final_reference(p, xn, jnp.bfloat16)
+    assert got.shape == want.shape == (2 * B_TILE, 64)
+    # kernel accumulates the matmuls in f32 (one rounding tighter than
+    # the scan path's bf16 matmul outputs): agreement to bf16 noise
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-2)
+
+
+def test_kernel_multi_tile_grid_interpret():
+    """Rows land in the right output block across grid programs."""
+    rng = jax.random.PRNGKey(2)
+    p = lstm_init(rng, 1, 64)
+    xn = jax.random.normal(jax.random.PRNGKey(3), (4 * B_TILE, 31),
+                           jnp.float32)
+    got = _pallas_final(xn, p["wx"].astype(jnp.bfloat16),
+                        p["wh"].astype(jnp.bfloat16),
+                        p["b"].reshape(1, -1), interpret=True)
+    # per-tile independence: running one tile alone gives the same rows
+    solo = _pallas_final(xn[B_TILE:2 * B_TILE],
+                         p["wx"].astype(jnp.bfloat16),
+                         p["wh"].astype(jnp.bfloat16),
+                         p["b"].reshape(1, -1), interpret=True)
+    np.testing.assert_allclose(np.asarray(got[B_TILE:2 * B_TILE]),
+                               np.asarray(solo), atol=1e-6)
+
+
+def test_score_fused_fallback_semantics():
+    """On CPU (pallas_ok False) score_fused must be bit-identical to
+    score — same function, same path."""
+    model = LstmAnomalyModel(LstmConfig(window=32))
+    params = model.init(jax.random.PRNGKey(4))
+    x = np.random.default_rng(0).standard_normal((300, 32)).astype(np.float32)
+    valid = np.ones((300, 32), bool)
+    assert not pallas_ok(300, 1)          # CPU backend + non-tile batch
+    a = np.asarray(model.score_fused(params, jnp.asarray(x),
+                                     jnp.asarray(valid)))
+    b = np.asarray(model.score(params, jnp.asarray(x), jnp.asarray(valid)))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_score_fused_kernel_path_parity_interpret():
+    """Force the kernel path (interpret) through the same normalize/
+    head/gate plumbing score_fused uses on TPU and compare to score."""
+    model = LstmAnomalyModel(LstmConfig(window=32))
+    params = model.init(jax.random.PRNGKey(5))
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((B_TILE, 32)).astype(np.float32) * 3.0 + 20.0
+    valid = np.ones((B_TILE, 32), bool)
+    valid[: B_TILE // 4, :28] = False      # short-history rows (4 < gate 8)
+    xj, vj = jnp.asarray(x), jnp.asarray(valid)
+
+    xn, _, _ = model._normalize(xj, vj.astype(jnp.float32))
+    h = lstm_window_final(params["lstm0"], xn[:, :-1],
+                          model.cfg.compute_dtype,
+                          use_pallas=True, interpret=True)
+    head = params["head"]
+    pred = (h @ head["w"] + head["b"])[:, 0]
+    err = jnp.abs(pred - xn[:, -1])
+    enough = vj.sum(-1) >= max(8, model.cfg.window // 8)
+    fused = np.asarray(jnp.clip(jnp.where(enough, err, 0.0), 0.0,
+                                model.cfg.score_clip))
+    ref = np.asarray(model.score(params, xj, vj))
+    np.testing.assert_allclose(fused, ref, atol=3e-2)
+    # the short-history gate stayed intact
+    assert (fused[: B_TILE // 4] == ref[: B_TILE // 4]).all()
+
+
+def test_pallas_ok_predicate():
+    assert not pallas_ok(B_TILE - 8, 1)    # not tile-divisible
+    assert not pallas_ok(B_TILE, 2)        # multi-layer
+    # non-bf16 compute_dtype must never take the bf16 kernel
+    assert not pallas_ok(B_TILE, 1, jnp.float32)
+    with pytest.raises(TypeError):
+        pallas_ok()                        # args are required

@@ -491,3 +491,48 @@ def test_rest_templated_tenant_scores_without_bootstrap(run):
                              ["scoring.e2e_latency_s"]["count"] >= 300)
 
     run(main())
+
+
+def test_rest_decoder_script_upload(run):
+    """Decoder scripts (event-sources extension surface) upload, list,
+    hot-reload, and delete over REST with the scripts authority."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme",
+                             "sections": {"rule-processing": {"model": None}}})
+            src = ("def decode(payload, ctx):\n"
+                   "    tok, val = payload.decode().split(',')\n"
+                   "    return [{'type': 'measurement', 'device': tok,\n"
+                   "             'value': float(val)}]\n")
+            status, s1 = await http(
+                port, "PUT", "/api/decoder-scripts/csv", token=tok,
+                tenant="acme", body={"source": src})
+            assert status == 200 and s1["version"] == 1
+            # async entrypoint is NOT acceptable for a decoder
+            # (rejected at upload, not at first event)
+            status, _ = await http(
+                port, "PUT", "/api/decoder-scripts/bad", token=tok,
+                tenant="acme",
+                body={"source": "async def decode(p, c):\n    return []"})
+            assert status == 400
+            # the uploaded script is usable by a new receiver
+            engine = rt.api("event-sources").engine("acme")
+            rx = engine.add_receiver({"kind": "queue",
+                                      "decoder": "script:csv",
+                                      "name": "csv"})
+            await rx.start()
+            status, scripts = await http(port, "GET", "/api/decoder-scripts",
+                                         token=tok, tenant="acme")
+            assert [s["name"] for s in scripts] == ["csv"]
+            await http(port, "DELETE", "/api/decoder-scripts/csv",
+                       token=tok, tenant="acme")
+            status, scripts = await http(port, "GET", "/api/decoder-scripts",
+                                         token=tok, tenant="acme")
+            assert scripts == []
+
+    run(main())
