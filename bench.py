@@ -183,6 +183,22 @@ def run_supervised(args, argv: list) -> int:
     fallback_note = None
     cpu_extra_args: list = []
 
+    def _cpu_shape_fleet() -> None:
+        # workload shape is ours to pick per platform: the TPU default
+        # fleet (32768 = one big flush per round, sized to the tunnel's
+        # inflight × bucket / RTT ceiling) drowns a CPU backend in
+        # per-flush work — measured ~770k ev/s at 4096 vs ~430k at
+        # 16384 on this rig — so unless the caller pinned --devices,
+        # CPU runs (explicit --force-cpu or fallback) get the
+        # CPU-shaped fleet
+        if not any(a == "--devices" or a.startswith("--devices=")
+                   for a in argv):
+            cpu_extra_args.append("--devices")
+            cpu_extra_args.append("4096")
+
+    if force_cpu:
+        _cpu_shape_fleet()
+
     def _cpu_fallback(reason: str) -> bool:
         nonlocal force_cpu, fallback_note
         print(f"[bench supervisor] {reason}; falling back to CPU",
@@ -195,15 +211,7 @@ def run_supervised(args, argv: list) -> int:
             return False
         force_cpu = True
         fallback_note = f"cpu ({reason})"
-        # workload shape is ours to pick per platform: the TPU default
-        # fleet (16384 = one big flush per round) drowns a CPU backend
-        # in per-flush work — measured ~770k ev/s at 4096 vs ~430k at
-        # 16384 on this rig — so unless the caller pinned --devices,
-        # let the fallback run the CPU-shaped fleet
-        if not any(a == "--devices" or a.startswith("--devices=")
-                   for a in argv):
-            cpu_extra_args.append("--devices")
-            cpu_extra_args.append("4096")
+        _cpu_shape_fleet()
         return True
 
     try:
@@ -937,7 +945,11 @@ def main() -> None:
     parser.add_argument("--model", default="lstm-stream",
                         choices=["lstm", "lstm-stream", "zscore", "tft",
                                  "longwin"])
-    parser.add_argument("--devices", type=int, default=16384)
+    # fleet = bucket = events per flush: tunneled-chip throughput is
+    # inflight × bucket / RTT, so bigger flushes raise the ceiling
+    # (32768 ≈ 2 MB in-flight upload, trivial against H2D bandwidth);
+    # the supervisor's CPU fallback overrides to a CPU-shaped 4096
+    parser.add_argument("--devices", type=int, default=32768)
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--sat-trials", type=int, default=3,
                         help="independent saturation windows; the best "

@@ -805,6 +805,14 @@ class RestServer(LifecycleComponent):
                                  f"{exc}") from exc
         return {"name": script.name, "version": script.version}
 
+    def _script_delete(self, req: Request, service: str, delete):
+        engine = self._engine(req, service)
+        try:
+            delete(engine)(req.params["name"])
+        except ValueError as exc:   # e.g. decoder still bound to a receiver
+            raise HttpError(409, str(exc)) from exc
+        return {"deleted": req.params["name"]}
+
     async def list_scripts(self, req: Request):
         return self._script_list(req, "rule-processing",
                                  lambda e: e.scripts)
@@ -814,9 +822,8 @@ class RestServer(LifecycleComponent):
                                 lambda e: e.put_script)
 
     async def delete_script(self, req: Request):
-        engine = self._engine(req, "rule-processing")
-        engine.delete_script(req.params["name"])
-        return {"deleted": req.params["name"]}
+        return self._script_delete(req, "rule-processing",
+                                   lambda e: e.delete_script)
 
     async def list_decoder_scripts(self, req: Request):
         return self._script_list(req, "event-sources",
@@ -827,9 +834,8 @@ class RestServer(LifecycleComponent):
                                 lambda e: e.put_decoder_script)
 
     async def delete_decoder_script(self, req: Request):
-        engine = self._engine(req, "event-sources")
-        engine.decoder_scripts.delete(req.params["name"])
-        return {"deleted": req.params["name"]}
+        return self._script_delete(req, "event-sources",
+                                   lambda e: e.delete_decoder_script)
 
     # -- handlers: device groups -------------------------------------------
 

@@ -500,6 +500,20 @@ class EventSourcesEngine(TenantEngine):
         `script:<name>` pick the new version up on their next decode)."""
         return self.decoder_scripts.put(name, source)
 
+    def delete_decoder_script(self, name: str):
+        """Delete a decoder script — refused while a live receiver still
+        references it (deleting under a receiver would silently shunt
+        ALL of its traffic to the failed topic until re-upload)."""
+        holders = [r.name for r in self.receivers
+                   if isinstance(getattr(r, "decoder", None),
+                                 ScriptedDecoder)
+                   and r.decoder._name == name]
+        if holders:
+            raise ValueError(
+                f"decoder script {name!r} is in use by receiver(s) "
+                f"{holders}; remove them first")
+        return self.decoder_scripts.delete(name)
+
     def _resolve_tokens(self):
         dm = self.runtime.api("device-management")
         tenant_id = self.tenant_id
@@ -563,6 +577,19 @@ class EventSourcesEngine(TenantEngine):
         self.receivers.append(r)
         self.add_child(r)
         return r
+
+    async def remove_receiver(self, name: str) -> bool:
+        """Stop and detach one receiver (dynamic source management —
+        the reference's analog is an event-sources config update +
+        engine restart; here single receivers come and go live)."""
+        for r in self.receivers:
+            if r.name == name:
+                await r.stop()
+                self.receivers.remove(r)
+                if r in self._children:
+                    self._children.remove(r)
+                return True
+        return False
 
     def receiver(self, name: str):
         for r in self.receivers:

@@ -949,3 +949,62 @@ def test_amqp_confirm_tags_nowait_and_aborted_oversize(run):
             await listener.stop()
 
     run(main())
+
+
+def test_amqp_malformed_fuzz_endpoint_survives(run):
+    """Fuzzed bytes on the AMQP port (random garbage, truncated valid
+    frame streams, giant declared frame sizes) kill at most their own
+    connection — a clean client afterwards still publishes."""
+
+    async def main():
+        from sitewhere_tpu.services.amqp import AmqpListener
+
+        got = []
+
+        async def on_message(key, body, source):
+            got.append(body)
+
+        listener = AmqpListener(on_message)
+        await listener.start()
+        try:
+            rng = np.random.default_rng(7)
+            # a valid connection byte stream up to the publish, for
+            # truncation fuzz
+            plain = b"\x00gw\x00pw"
+            valid = (b"AMQP\x00\x00\x09\x01"
+                     + _amqp_frame(1, 0, _amqp_method(
+                         10, 11, struct.pack(">I", 0) + _amqp_ss("PLAIN")
+                         + _amqp_ls(plain) + _amqp_ss("en_US")))
+                     + _amqp_frame(1, 0, _amqp_method(
+                         10, 31, struct.pack(">HIH", 0, 131072, 0)))
+                     + _amqp_frame(1, 0, _amqp_method(
+                         10, 40, _amqp_ss("/") + _amqp_ss("") + b"\x00"))
+                     + _amqp_frame(1, 1, _amqp_method(20, 10, _amqp_ss("")))
+                     + _amqp_publish_frames("k", b"x"))
+            for i in range(60):
+                r, w = await asyncio.open_connection("127.0.0.1",
+                                                     listener.port)
+                kind = i % 3
+                if kind == 0:      # pure garbage
+                    n = int(rng.integers(1, 128))
+                    w.write(bytes(rng.integers(0, 256, n, dtype=np.uint8)))
+                elif kind == 1:    # truncated valid stream
+                    cut = int(rng.integers(1, len(valid)))
+                    w.write(valid[:cut])
+                else:              # huge declared frame size after header
+                    w.write(b"AMQP\x00\x00\x09\x01"
+                            + struct.pack(">BHI", 1, 0, 0x7FFFFFFF))
+                await w.drain()
+                w.close()
+            await asyncio.sleep(0.2)
+            # endpoint alive: a clean client still connects + publishes
+            before = len(got)
+            reader, writer = await _amqp_connect(listener.port)
+            writer.write(_amqp_publish_frames("k", b"after-fuzz"))
+            await wait_until(lambda: len(got) > before, timeout=5.0)
+            assert got[-1] == b"after-fuzz"
+            writer.close()
+        finally:
+            await listener.stop()
+
+    run(main())

@@ -529,8 +529,17 @@ def test_rest_decoder_script_upload(run):
             status, scripts = await http(port, "GET", "/api/decoder-scripts",
                                          token=tok, tenant="acme")
             assert [s["name"] for s in scripts] == ["csv"]
-            await http(port, "DELETE", "/api/decoder-scripts/csv",
-                       token=tok, tenant="acme")
+            # deleting while a live receiver references it → 409, kept
+            status, err = await http(port, "DELETE",
+                                     "/api/decoder-scripts/csv",
+                                     token=tok, tenant="acme")
+            assert status == 409 and "in use" in err["error"]
+            # unbind the receiver, then delete succeeds
+            assert await engine.remove_receiver("csv")
+            status, _ = await http(port, "DELETE",
+                                   "/api/decoder-scripts/csv",
+                                   token=tok, tenant="acme")
+            assert status == 200
             status, scripts = await http(port, "GET", "/api/decoder-scripts",
                                          token=tok, tenant="acme")
             assert scripts == []
