@@ -94,6 +94,15 @@ class LifecycleComponent:
 
     # -- composition -------------------------------------------------------
 
+    def remove_child(self, child: "LifecycleComponent") -> bool:
+        """Detach a (stopped) child from lifecycle management — the
+        inverse of add_child for dynamically-managed components (e.g.
+        event-source receivers that come and go live)."""
+        if child in self._children:
+            self._children.remove(child)
+            return True
+        return False
+
     def add_child(self, child: "LifecycleComponent") -> "LifecycleComponent":
         child.parent = self
         self._children.append(child)

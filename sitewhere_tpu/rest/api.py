@@ -319,7 +319,20 @@ class RestServer(LifecycleComponent):
           self.add_measurement)
         r("GET", r"/api/assignments/(?P<token>[^/]+)/locations",
           self.list_locations)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/locations",
+          self.add_location)
         r("GET", r"/api/assignments/(?P<token>[^/]+)/alerts", self.list_alerts)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/alerts", self.add_alert)
+        r("GET", r"/api/assignments/(?P<token>[^/]+)/invocations",
+          self.list_invocations)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/responses",
+          self.add_command_response)
+        r("GET", r"/api/invocations/(?P<id>[^/]+)/responses",
+          self.list_command_responses)
+        r("GET", r"/api/assignments/(?P<token>[^/]+)/statechanges",
+          self.list_state_changes)
+        r("POST", r"/api/assignments/(?P<token>[^/]+)/statechanges",
+          self.add_state_change)
         r("POST", r"/api/assignments/(?P<token>[^/]+)/invocations",
           self.invoke_command)
         # areas / customers / zones / assets
@@ -357,6 +370,14 @@ class RestServer(LifecycleComponent):
           self.put_decoder_script, AUTH_ADMIN_SCRIPTS)
         r("DELETE", r"/api/decoder-scripts/(?P<name>[^/]+)",
           self.delete_decoder_script, AUTH_ADMIN_SCRIPTS)
+        # event-source receivers (dynamic source management; a decoder
+        # script's delete-409 is resolvable through this surface)
+        r("GET", r"/api/eventsources/receivers", self.list_receivers,
+          AUTH_ADMIN_SCRIPTS)
+        r("POST", r"/api/eventsources/receivers", self.add_receiver,
+          AUTH_ADMIN_SCRIPTS)
+        r("DELETE", r"/api/eventsources/receivers/(?P<name>[^/]+)",
+          self.delete_receiver, AUTH_ADMIN_SCRIPTS)
         # labels
         r("GET", r"/api/labels/devices/(?P<token>[^/]+)", self.device_label)
 
@@ -586,31 +607,127 @@ class RestServer(LifecycleComponent):
             limit=req.int_qp("limit", 100))
         return [event_to_dict(m) for m in ms]
 
-    async def add_measurement(self, req: Request):
-        """Cold-path single-event ingest (reference REST parity; bulk
-        telemetry uses the SWB1 gateway path)."""
-        from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
-        import time as _time
+    async def _ingest_cold_batch(self, req: Request, build) -> dict:
+        """Shared cold-path single-event ingest (reference REST parity;
+        bulk telemetry uses the SWB1 gateway path): build the columnar
+        batch — dtype coercion errors are the CLIENT's (400, not a
+        poisoned persister loop) — and publish it on the decoded topic,
+        the same route gateway batches take."""
+        from sitewhere_tpu.kernel.bus import TopicNaming
 
         idx = self._assignment_device_index(req)
         b = req.json()
-        tenant_id = self._tenant_id(req)
-        batch = MeasurementBatch(
-            BatchContext(tenant_id=tenant_id, source="rest"),
-            np.asarray([idx], np.uint32),
-            np.asarray([b.get("mtype", 0)], np.uint16),
-            np.asarray([b.get("value", 0.0)], np.float32),
-            np.asarray([b.get("eventDate", _time.time())], np.float64))
+        try:
+            batch = build(idx, b, self._tenant_id(req))
+        except (TypeError, ValueError) as exc:
+            raise HttpError(400, f"bad event payload: {exc}") from exc
         sources = self._engine(req, "event-sources")
         await self.runtime.bus.produce(
-            sources.tenant_topic("event-source-decoded-events"), batch,
+            sources.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED), batch,
             key="rest")
         return {"accepted": 1}
+
+    async def add_measurement(self, req: Request):
+        from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+        import time as _time
+
+        def build(idx, b, tenant_id):
+            return MeasurementBatch(
+                BatchContext(tenant_id=tenant_id, source="rest"),
+                np.asarray([idx], np.uint32),
+                np.asarray([b.get("mtype", 0)], np.uint16),
+                np.asarray([b.get("value", 0.0)], np.float32),
+                np.asarray([b.get("eventDate", _time.time())], np.float64))
+
+        return await self._ingest_cold_batch(req, build)
 
     async def list_locations(self, req: Request):
         idx = self._assignment_device_index(req)
         return [event_to_dict(loc) for loc in self._em(req).list_locations(
             idx, limit=req.int_qp("limit", 100))]
+
+    async def add_location(self, req: Request):
+        from sitewhere_tpu.domain.batch import BatchContext, LocationBatch
+        import time as _time
+
+        def build(idx, b, tenant_id):
+            return LocationBatch(
+                BatchContext(tenant_id=tenant_id, source="rest"),
+                np.asarray([idx], np.uint32),
+                np.asarray([b.get("latitude", 0.0)], np.float64),
+                np.asarray([b.get("longitude", 0.0)], np.float64),
+                np.asarray([b.get("elevation", 0.0)], np.float32),
+                np.asarray([b.get("eventDate", _time.time())], np.float64))
+
+        return await self._ingest_cold_batch(req, build)
+
+    async def add_alert(self, req: Request):
+        """Operator-sourced alert (reference REST parity; model alerts
+        come from the scoring plane)."""
+        import time as _time
+
+        from sitewhere_tpu.domain.events import AlertLevel, DeviceAlert
+
+        a = self._assignment(req)
+        b = req.json()
+        try:
+            level = AlertLevel[str(b.get("level", "INFO")).upper()]
+        except KeyError as exc:
+            raise HttpError(400, f"unknown alert level {b.get('level')!r}") \
+                from exc
+        alert = DeviceAlert(
+            device_id=a.device_id, assignment_id=a.id,
+            type=b.get("type", "operator"),
+            message=b.get("message", ""),
+            level=level,
+            source=b.get("source", "rest"),
+            event_date=b.get("eventDate") or _time.time())
+        out = await self._em(req).add_alerts([alert])
+        return event_to_dict(out[0])
+
+    async def list_invocations(self, req: Request):
+        idx = self._assignment_device_index(req)
+        return [event_to_dict(i)
+                for i in self._em(req).list_command_invocations(
+                    idx, limit=req.int_qp("limit", 100))]
+
+    async def add_command_response(self, req: Request):
+        from sitewhere_tpu.domain.events import DeviceCommandResponse
+
+        a = self._assignment(req)
+        b = req.json()
+        resp = DeviceCommandResponse(
+            device_id=a.device_id, assignment_id=a.id,
+            originating_event_id=b.get("originatingEventId", ""),
+            response=b.get("response", ""))
+        out = await self._em(req).add_command_responses([resp])
+        return event_to_dict(out[0])
+
+    async def list_command_responses(self, req: Request):
+        return [event_to_dict(r)
+                for r in self._em(req).list_command_responses(
+                    originating_event_id=req.params["id"],
+                    limit=req.int_qp("limit", 100))]
+
+    async def add_state_change(self, req: Request):
+        from sitewhere_tpu.domain.events import DeviceStateChange
+
+        a = self._assignment(req)
+        b = req.json()
+        change = DeviceStateChange(
+            device_id=a.device_id, assignment_id=a.id,
+            attribute=b.get("attribute", "state"),
+            state_change_type=b.get("type", "state"),
+            previous_state=b.get("previousState", ""),
+            new_state=b.get("newState", ""))
+        out = await self._em(req).add_state_changes([change])
+        return event_to_dict(out[0])
+
+    async def list_state_changes(self, req: Request):
+        idx = self._assignment_device_index(req)
+        return [event_to_dict(c)
+                for c in self._em(req).list_state_changes(
+                    idx, limit=req.int_qp("limit", 100))]
 
     async def list_alerts(self, req: Request):
         idx = self._assignment_device_index(req)
@@ -836,6 +953,34 @@ class RestServer(LifecycleComponent):
     async def delete_decoder_script(self, req: Request):
         return self._script_delete(req, "event-sources",
                                    lambda e: e.delete_decoder_script)
+
+    # -- handlers: event-source receivers -----------------------------------
+
+    async def list_receivers(self, req: Request):
+        engine = self._engine(req, "event-sources")
+        return [{"name": r.name, "kind": type(r).__name__,
+                 "port": getattr(r, "port", None)}
+                for r in engine.receivers]
+
+    async def add_receiver(self, req: Request):
+        engine = self._engine(req, "event-sources")
+        b = req.json()
+        if any(r.name == b.get("name") for r in engine.receivers):
+            raise HttpError(409, f"receiver {b.get('name')!r} exists")
+        try:
+            receiver = engine.add_receiver(b)
+        except (KeyError, ValueError) as exc:
+            raise HttpError(400, f"bad receiver config: {exc}") from exc
+        await receiver.start()
+        return {"name": receiver.name,
+                "port": getattr(receiver, "port", None)}
+
+    async def delete_receiver(self, req: Request):
+        engine = self._engine(req, "event-sources")
+        if not await engine.remove_receiver(req.params["name"]):
+            raise HttpError(404,
+                            f"unknown receiver {req.params['name']!r}")
+        return {"deleted": req.params["name"]}
 
     # -- handlers: device groups -------------------------------------------
 

@@ -63,6 +63,19 @@ class EventManagementEngine(TenantEngine):
         await self.runtime.bus.produce(self._enriched_topic, list(out))
         return out
 
+    async def add_command_responses(
+            self, responses: Sequence[DeviceCommandResponse]):
+        """Persist device command responses and republish (closes the
+        command round trip: invoke → deliver → respond)."""
+        out = self.spi.add_command_responses(responses)
+        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        return out
+
+    async def add_state_changes(self, changes: Sequence[DeviceStateChange]):
+        out = self.spi.add_state_changes(changes)
+        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        return out
+
     def __getattr__(self, name):
         return getattr(self.spi, name)
 

@@ -586,8 +586,7 @@ class EventSourcesEngine(TenantEngine):
             if r.name == name:
                 await r.stop()
                 self.receivers.remove(r)
-                if r in self._children:
-                    self._children.remove(r)
+                self.remove_child(r)
                 return True
         return False
 

@@ -520,12 +520,30 @@ def test_rest_decoder_script_upload(run):
                 tenant="acme",
                 body={"source": "async def decode(p, c):\n    return []"})
             assert status == 400
-            # the uploaded script is usable by a new receiver
+            # the uploaded script is usable by a new receiver,
+            # created over REST (dynamic source management)
+            status, r = await http(
+                port, "POST", "/api/eventsources/receivers", token=tok,
+                tenant="acme", body={"kind": "queue",
+                                     "decoder": "script:csv",
+                                     "name": "csv"})
+            assert status == 200 and r["name"] == "csv"
+            status, rs = await http(
+                port, "GET", "/api/eventsources/receivers", token=tok,
+                tenant="acme")
+            assert "csv" in [x["name"] for x in rs]
+            # duplicate name and unknown decoder are client errors
+            status, _ = await http(
+                port, "POST", "/api/eventsources/receivers", token=tok,
+                tenant="acme", body={"kind": "queue", "name": "csv"})
+            assert status == 409
+            status, _ = await http(
+                port, "POST", "/api/eventsources/receivers", token=tok,
+                tenant="acme", body={"kind": "queue",
+                                     "decoder": "script:nope",
+                                     "name": "x"})
+            assert status == 400
             engine = rt.api("event-sources").engine("acme")
-            rx = engine.add_receiver({"kind": "queue",
-                                      "decoder": "script:csv",
-                                      "name": "csv"})
-            await rx.start()
             status, scripts = await http(port, "GET", "/api/decoder-scripts",
                                          token=tok, tenant="acme")
             assert [s["name"] for s in scripts] == ["csv"]
@@ -534,8 +552,15 @@ def test_rest_decoder_script_upload(run):
                                      "/api/decoder-scripts/csv",
                                      token=tok, tenant="acme")
             assert status == 409 and "in use" in err["error"]
-            # unbind the receiver, then delete succeeds
-            assert await engine.remove_receiver("csv")
+            # unbind the receiver OVER REST, then delete succeeds
+            status, _ = await http(
+                port, "DELETE", "/api/eventsources/receivers/csv",
+                token=tok, tenant="acme")
+            assert status == 200
+            status, _ = await http(
+                port, "DELETE", "/api/eventsources/receivers/csv",
+                token=tok, tenant="acme")
+            assert status == 404
             status, _ = await http(port, "DELETE",
                                    "/api/decoder-scripts/csv",
                                    token=tok, tenant="acme")
@@ -543,5 +568,111 @@ def test_rest_decoder_script_upload(run):
             status, scripts = await http(port, "GET", "/api/decoder-scripts",
                                          token=tok, tenant="acme")
             assert scripts == []
+
+    run(main())
+
+
+def test_rest_full_event_type_surface(run):
+    """Every reference event type has REST create+query parity:
+    location (pipeline path), alert, command invocation → response
+    (correlated by originating event id), state change."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme",
+                             "sections": {"rule-processing": {"model": None}}})
+            await http(port, "POST", "/api/devicetypes", token=tok,
+                       tenant="acme", body={"token": "thermo", "name": "T"})
+            await http(port, "POST", "/api/devices", token=tok,
+                       tenant="acme",
+                       body={"token": "dev-1", "deviceType": "thermo"})
+
+            # location: through the real pipeline (decoded-events topic)
+            status, r = await http(
+                port, "POST", "/api/assignments/dev-1-a/locations",
+                token=tok, tenant="acme",
+                body={"latitude": 47.3, "longitude": 8.5,
+                      "elevation": 410.0, "eventDate": 2000.0})
+            assert status == 200 and r["accepted"] == 1
+            for _ in range(100):
+                s, locs = await http(
+                    port, "GET", "/api/assignments/dev-1-a/locations",
+                    token=tok, tenant="acme")
+                if s == 200 and len(locs) == 1:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("location never visible")
+            assert abs(locs[0]["latitude"] - 47.3) < 1e-9
+            # non-numeric coordinates are the client's error (400), not
+            # a poisoned persister loop
+            status, err = await http(
+                port, "POST", "/api/assignments/dev-1-a/locations",
+                token=tok, tenant="acme", body={"latitude": "north"})
+            assert status == 400
+            status, _ = await http(
+                port, "POST", "/api/assignments/dev-1-a/alerts",
+                token=tok, tenant="acme", body={"level": 2})
+            assert status == 400
+
+            # operator alert
+            status, alert = await http(
+                port, "POST", "/api/assignments/dev-1-a/alerts",
+                token=tok, tenant="acme",
+                body={"type": "overheat", "message": "too hot",
+                      "level": "warning"})
+            assert status == 200 and alert["level"] == "warning"
+            status, alerts = await http(
+                port, "GET", "/api/assignments/dev-1-a/alerts",
+                token=tok, tenant="acme")
+            assert [a["type"] for a in alerts] == ["overheat"]
+            status, _ = await http(
+                port, "POST", "/api/assignments/dev-1-a/alerts",
+                token=tok, tenant="acme", body={"level": "nope"})
+            assert status == 400
+
+            # invocation → response, correlated
+            status, cmd = await http(
+                port, "POST", "/api/devicetypes/thermo/commands",
+                token=tok, tenant="acme",
+                body={"token": "reboot", "name": "reboot"})
+            status, inv = await http(
+                port, "POST", "/api/assignments/dev-1-a/invocations",
+                token=tok, tenant="acme", body={"commandToken": "reboot"})
+            assert status == 200
+            status, invs = await http(
+                port, "GET", "/api/assignments/dev-1-a/invocations",
+                token=tok, tenant="acme")
+            assert [i["id"] for i in invs] == [inv["id"]]
+            status, resp = await http(
+                port, "POST", "/api/assignments/dev-1-a/responses",
+                token=tok, tenant="acme",
+                body={"originatingEventId": inv["id"], "response": "ok"})
+            assert status == 200
+            status, resps = await http(
+                port, "GET", f"/api/invocations/{inv['id']}/responses",
+                token=tok, tenant="acme")
+            assert [r["response"] for r in resps] == ["ok"]
+            # responses for an unknown invocation → empty, not error
+            status, none = await http(
+                port, "GET", "/api/invocations/nope/responses",
+                token=tok, tenant="acme")
+            assert status == 200 and none == []
+
+            # state change
+            status, sc = await http(
+                port, "POST", "/api/assignments/dev-1-a/statechanges",
+                token=tok, tenant="acme",
+                body={"attribute": "firmware", "previousState": "1.0",
+                      "newState": "1.1"})
+            assert status == 200
+            status, scs = await http(
+                port, "GET", "/api/assignments/dev-1-a/statechanges",
+                token=tok, tenant="acme")
+            assert [c["new_state"] for c in scs] == ["1.1"]
 
     run(main())
