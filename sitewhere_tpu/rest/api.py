@@ -294,6 +294,7 @@ class RestServer(LifecycleComponent):
         r("POST", r"/api/devices", self.create_device)
         r("GET", r"/api/devices/(?P<token>[^/]+)", self.get_device)
         r("DELETE", r"/api/devices/(?P<token>[^/]+)", self.delete_device)
+        r("GET", r"/api/devicestates/missing", self.list_missing_devices)
         r("GET", r"/api/devices/(?P<token>[^/]+)/state", self.get_device_state)
         # device groups
         r("GET", r"/api/devicegroups", self.list_device_groups)
@@ -560,6 +561,22 @@ class RestServer(LifecycleComponent):
         engine = self._engine(req, "device-state")
         return engine.get_state(device.index)
 
+    async def list_missing_devices(self, req: Request):
+        """Devices seen before but silent for olderThan seconds
+        (reference: device-state missing-device marking). `now` is an
+        optional epoch override for simulated-clock fleets."""
+        engine = self._engine(req, "device-state")
+        dm = self._dm(req)
+        idxs = engine.missing_devices(
+            req.float_qp("olderThan", 300.0),
+            now=req.float_qp("now", 0.0) or None)
+        out = []
+        for i in idxs.tolist():
+            device = dm.get_device_by_index(i)
+            if device is not None:
+                out.append({"token": device.token, "index": i})
+        return out
+
     # -- handlers: assignments + events ------------------------------------
 
     def _assignment(self, req: Request) -> DeviceAssignment:
@@ -681,7 +698,7 @@ class RestServer(LifecycleComponent):
             message=b.get("message", ""),
             level=level,
             source=b.get("source", "rest"),
-            event_date=b.get("eventDate") or _time.time())
+            event_date=b.get("eventDate", _time.time()))
         out = await self._em(req).add_alerts([alert])
         return event_to_dict(out[0])
 
@@ -965,13 +982,27 @@ class RestServer(LifecycleComponent):
     async def add_receiver(self, req: Request):
         engine = self._engine(req, "event-sources")
         b = req.json()
-        if any(r.name == b.get("name") for r in engine.receivers):
+        existing = {r.name for r in engine.receivers}
+        if b.get("name") in existing:
             raise HttpError(409, f"receiver {b.get('name')!r} exists")
         try:
             receiver = engine.add_receiver(b)
         except (KeyError, ValueError) as exc:
             raise HttpError(400, f"bad receiver config: {exc}") from exc
-        await receiver.start()
+        if b.get("name") is None and receiver.name in existing:
+            # engine-generated name collided with a survivor of an
+            # earlier deletion (names are f"{kind}-{len(receivers)}")
+            await engine.remove_receiver(receiver.name)
+            raise HttpError(409, f"receiver {receiver.name!r} exists; "
+                                 "pass an explicit name")
+        try:
+            await receiver.start()
+        except Exception as exc:
+            # a receiver that never started must not squat its name or
+            # pin its decoder script
+            await engine.remove_receiver(receiver.name)
+            raise HttpError(400, f"receiver failed to start: {exc}") \
+                from exc
         return {"name": receiver.name,
                 "port": getattr(receiver, "port", None)}
 

@@ -10,6 +10,7 @@ missing-device queries are one boolean reduction.
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 import numpy as np
@@ -33,6 +34,11 @@ class DeviceStateEngine(TenantEngine):
         self.last_location_ts = np.zeros(cap, np.float64)
         self.merger = StateMerger(self)
         self.add_child(self.merger)
+        presence = tenant.section("device-state", {}).get("presence")
+        self.presence: PresenceMonitor | None = None
+        if presence:
+            self.presence = PresenceMonitor(self, presence)
+            self.add_child(self.presence)
 
     def _ensure(self, max_index: int) -> None:
         if max_index < self.capacity:
@@ -150,6 +156,75 @@ class StateMerger(BackgroundTaskComponent):
                 consumer.commit()
         finally:
             consumer.close()
+
+
+class PresenceMonitor(BackgroundTaskComponent):
+    """Automated presence management (reference: device-state presence
+    manager marking assignments missing): on an interval, devices whose
+    `last_seen` is older than `missing_after_s` transition
+    present→missing, and a later event transitions them back — each
+    transition persisted as a DeviceStateChange (attribute "presence")
+    through event-management, so downstream consumers (connectors,
+    rules, REST queries) see presence like any other event.
+
+    Config (tenant section `device-state`):
+        presence:
+          missing_after_s: 3600     # silence that means "missing"
+          check_interval_s: 60
+    """
+
+    def __init__(self, engine: DeviceStateEngine, cfg: dict):
+        super().__init__("presence-monitor")
+        self.engine = engine
+        self.missing_after_s = float(cfg.get("missing_after_s", 3600.0))
+        self.check_interval_s = float(cfg.get("check_interval_s", 60.0))
+        self.missing: set[int] = set()   # indices currently marked missing
+        self._now = time.time            # test seam (simulated clocks)
+
+    async def _run(self) -> None:
+        engine = self.engine
+        runtime = engine.runtime
+        transitions = runtime.metrics.counter(
+            "device_state.presence_transitions")
+        em = await runtime.wait_for_engine("event-management",
+                                           engine.tenant_id)
+        dm = await runtime.wait_for_engine("device-management",
+                                           engine.tenant_id)
+        while True:
+            now = self._now()
+            gone = set(engine.missing_devices(self.missing_after_s,
+                                              now=now).tolist())
+            changes = []
+            for idx in sorted(gone - self.missing):
+                changes.append((idx, "present", "missing"))
+            for idx in sorted(self.missing - gone):
+                # last_seen only grows, so leaving the missing mask
+                # means a fresh event arrived: the device recovered
+                changes.append((idx, "missing", "present"))
+            if changes:
+                from sitewhere_tpu.domain.events import DeviceStateChange
+
+                events = []
+                for idx, prev, new in changes:
+                    device = dm.get_device_by_index(idx)
+                    if device is None:
+                        continue
+                    assignments = dm.get_active_assignments_for_device(
+                        device.id)
+                    events.append(DeviceStateChange(
+                        device_id=device.id,
+                        assignment_id=assignments[0].id if assignments
+                        else "",
+                        attribute="presence", state_change_type="presence",
+                        previous_state=prev, new_state=new))
+                    if new == "missing":
+                        self.missing.add(idx)
+                    else:
+                        self.missing.discard(idx)
+                if events:
+                    await em.add_state_changes(events)
+                    transitions.inc(len(events))
+            await asyncio.sleep(self.check_interval_s)
 
 
 class DeviceStateService(Service):

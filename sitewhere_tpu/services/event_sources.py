@@ -584,9 +584,13 @@ class EventSourcesEngine(TenantEngine):
         engine restart; here single receivers come and go live)."""
         for r in self.receivers:
             if r.name == name:
-                await r.stop()
-                self.receivers.remove(r)
-                self.remove_child(r)
+                try:
+                    await r.stop()
+                finally:
+                    # detach even when stop fails (an errored receiver
+                    # must not squat its name forever)
+                    self.receivers.remove(r)
+                    self.remove_child(r)
                 return True
         return False
 

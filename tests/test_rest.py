@@ -543,6 +543,21 @@ def test_rest_decoder_script_upload(run):
                                      "decoder": "script:nope",
                                      "name": "x"})
             assert status == 400
+            # a receiver whose start() fails (port already in use) must
+            # not squat its name: creation 400s AND the name is free
+            blocker = await asyncio.start_server(
+                lambda r, w: None, "127.0.0.1", 0)
+            taken = blocker.sockets[0].getsockname()[1]
+            status, _ = await http(
+                port, "POST", "/api/eventsources/receivers", token=tok,
+                tenant="acme", body={"kind": "tcp", "name": "t1",
+                                     "port": taken})
+            blocker.close()
+            assert status == 400
+            status, rs = await http(
+                port, "GET", "/api/eventsources/receivers", token=tok,
+                tenant="acme")
+            assert "t1" not in [x["name"] for x in rs]
             engine = rt.api("event-sources").engine("acme")
             status, scripts = await http(port, "GET", "/api/decoder-scripts",
                                          token=tok, tenant="acme")
@@ -674,5 +689,17 @@ def test_rest_full_event_type_surface(run):
                 port, "GET", "/api/assignments/dev-1-a/statechanges",
                 token=tok, tenant="acme")
             assert [c["new_state"] for c in scs] == ["1.1"]
+
+            # missing-device query: dev-1 last reported at ts 2000
+            status, missing = await http(
+                port, "GET",
+                "/api/devicestates/missing?olderThan=1000&now=5000",
+                token=tok, tenant="acme")
+            assert status == 200 and [m["token"] for m in missing] == ["dev-1"]
+            status, missing = await http(
+                port, "GET",
+                "/api/devicestates/missing?olderThan=9000&now=5000",
+                token=tok, tenant="acme")
+            assert missing == []
 
     run(main())

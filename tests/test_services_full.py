@@ -591,3 +591,58 @@ def test_scripted_decoder_csv_and_hot_reload(run):
             assert sources.decoder_scripts.get("csv").version == 2
 
     run(main())
+
+
+def test_presence_monitor_marks_missing_and_recovers(run):
+    """Automated presence management: silent devices transition
+    present→missing as persisted state-change events; a fresh event
+    transitions them back. (Reference: device-state presence manager.)"""
+
+    async def main():
+        sections = {"device-state": {"presence": {
+            "missing_after_s": 100.0, "check_interval_s": 0.05}}}
+        async with full_instance(sections, num_devices=5) as rt:
+            ds = rt.api("device-state").state("acme")
+            em = rt.api("event-management").management("acme")
+            sources = rt.api("event-sources").engine("acme")
+            sim_clock = [1000.0]
+            ds.presence._now = lambda: sim_clock[0]
+
+            from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+            sim = DeviceSimulator(SimConfig(num_devices=5), tenant_id="acme")
+            await sources.receiver("default").submit(
+                sim.payload(t=1000.0)[0])
+            await wait_until(lambda: em.telemetry.total_events == 5)
+            await wait_until(lambda: float(ds.last_seen[:5].min()) == 1000.0)
+            await asyncio.sleep(0.2)
+            assert em.list_state_changes() == []   # all present, no noise
+
+            # clock jumps: everyone is now silent too long
+            sim_clock[0] = 2000.0
+            await wait_until(lambda: len(em.list_state_changes()) == 5,
+                             timeout=10.0)
+            changes = em.list_state_changes()
+            assert {c.new_state for c in changes} == {"missing"}
+            assert all(c.attribute == "presence" for c in changes)
+            assert len(ds.presence.missing) == 5
+
+            # device 2 reports again (fresh timestamp) → recovers
+            batch, _ = sim.tick(t=1999.0)
+            mask = batch.device_index == 2
+            import dataclasses as _dc
+            single = _dc.replace(
+                batch, device_index=batch.device_index[mask],
+                mtype=batch.mtype[mask], value=batch.value[mask],
+                ts=batch.ts[mask])
+            em.telemetry.append_measurements(single)
+            ds.merge_measurements(single)
+            await wait_until(
+                lambda: any(c.new_state == "present"
+                            for c in em.list_state_changes()), timeout=10.0)
+            recovered = [c for c in em.list_state_changes()
+                         if c.new_state == "present"]
+            assert len(recovered) == 1
+            assert 2 not in ds.presence.missing
+            assert len(ds.presence.missing) == 4
+
+    run(main())
