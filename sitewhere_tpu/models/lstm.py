@@ -98,6 +98,32 @@ class LstmAnomalyModel:
         preds = self._predictions(params, xn)
         return self._finalize(preds[:, -1], xn, valid)
 
+    def forecast(self, params: dict, x: jax.Array,
+                 valid: jax.Array) -> jax.Array:
+        """One-step-ahead point forecast in ORIGINAL units: [B, 1, 1]
+        (the uniform [B, H, Q] forecast shape; the TFT's multi-horizon
+        quantile twin is models/tft.py `forecast`).
+
+        Runs the cell over ALL W observed steps and takes the output
+        after the last one — the prediction of the NEXT, unseen value
+        (`_predictions` feeds xn[:, :-1] because scoring compares
+        pred(t) with the observed x_t; a forecast must not stop one
+        step short or it merely reconstructs the newest observation)."""
+        from sitewhere_tpu.models.common import lstm_scan
+
+        cfg = self.cfg
+        xn, mu, sd = self._normalize(x, valid.astype(jnp.float32))
+        seq = xn[:, :, None].astype(cfg.compute_dtype)
+        for layer in range(cfg.layers):
+            seq, _ = lstm_scan(params[f"lstm{layer}"], seq,
+                               cfg.compute_dtype)
+            seq = seq.astype(cfg.compute_dtype)
+        head = params["head"]
+        pred_n = (seq[:, -1].astype(jnp.float32) @ head["w"]
+                  + head["b"])[:, 0]
+        pred = pred_n * sd[:, 0] + mu[:, 0]
+        return pred[:, None, None]
+
     def score_fused(self, params: dict, x: jax.Array,
                     valid: jax.Array) -> jax.Array:
         """`score` with the recurrence in the Pallas fused-window kernel

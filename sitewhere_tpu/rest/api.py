@@ -296,6 +296,8 @@ class RestServer(LifecycleComponent):
         r("DELETE", r"/api/devices/(?P<token>[^/]+)", self.delete_device)
         r("GET", r"/api/devicestates/missing", self.list_missing_devices)
         r("GET", r"/api/devices/(?P<token>[^/]+)/state", self.get_device_state)
+        r("GET", r"/api/devices/(?P<token>[^/]+)/forecast",
+          self.get_device_forecast)
         # device groups
         r("GET", r"/api/devicegroups", self.list_device_groups)
         r("POST", r"/api/devicegroups", self.create_device_group)
@@ -561,6 +563,17 @@ class RestServer(LifecycleComponent):
         engine = self._engine(req, "device-state")
         return engine.get_state(device.index)
 
+    async def get_device_forecast(self, req: Request):
+        """Model forecast for a device (config 3's capability as a
+        product surface): [horizon, quantiles] values in original
+        units. 404 when the tenant's model has no forecast."""
+        device = self._device_by_token(req, req.params["token"])
+        engine = self._engine(req, "rule-processing")
+        try:
+            return await engine.forecast_device(device.index)
+        except LookupError as exc:
+            raise HttpError(404, str(exc)) from exc
+
     async def list_missing_devices(self, req: Request):
         """Devices seen before but silent for olderThan seconds
         (reference: device-state missing-device marking). `now` is an
@@ -698,7 +711,8 @@ class RestServer(LifecycleComponent):
             message=b.get("message", ""),
             level=level,
             source=b.get("source", "rest"),
-            event_date=b.get("eventDate", _time.time()))
+            event_date=(_time.time() if b.get("eventDate") is None
+                        else b["eventDate"]))
         out = await self._em(req).add_alerts([alert])
         return event_to_dict(out[0])
 
@@ -989,12 +1003,6 @@ class RestServer(LifecycleComponent):
             receiver = engine.add_receiver(b)
         except (KeyError, ValueError) as exc:
             raise HttpError(400, f"bad receiver config: {exc}") from exc
-        if b.get("name") is None and receiver.name in existing:
-            # engine-generated name collided with a survivor of an
-            # earlier deletion (names are f"{kind}-{len(receivers)}")
-            await engine.remove_receiver(receiver.name)
-            raise HttpError(409, f"receiver {receiver.name!r} exists; "
-                                 "pass an explicit name")
         try:
             await receiver.start()
         except Exception as exc:

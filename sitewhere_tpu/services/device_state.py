@@ -206,6 +206,13 @@ class PresenceMonitor(BackgroundTaskComponent):
 
                 events = []
                 for idx, prev, new in changes:
+                    # bookkeeping FIRST — a device deleted from
+                    # device-management must not leave its index
+                    # re-emitting phantom transitions every cycle
+                    if new == "missing":
+                        self.missing.add(idx)
+                    else:
+                        self.missing.discard(idx)
                     device = dm.get_device_by_index(idx)
                     if device is None:
                         continue
@@ -217,10 +224,6 @@ class PresenceMonitor(BackgroundTaskComponent):
                         else "",
                         attribute="presence", state_change_type="presence",
                         previous_state=prev, new_state=new))
-                    if new == "missing":
-                        self.missing.add(idx)
-                    else:
-                        self.missing.discard(idx)
                 if events:
                     await em.add_state_changes(events)
                     transitions.inc(len(events))

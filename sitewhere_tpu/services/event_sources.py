@@ -539,7 +539,15 @@ class EventSourcesEngine(TenantEngine):
     def add_receiver(self, cfg: dict) -> LifecycleComponent:
         decoder = self._make_decoder(cfg.get("decoder", "swb1"))
         kind = cfg.get("kind", "queue")
-        name = cfg.get("name", f"{kind}-{len(self.receivers)}")
+        name = cfg.get("name")
+        if name is None:
+            # generated names must not collide with survivors of earlier
+            # deletions (len(receivers) alone can repeat after removal)
+            taken = {r.name for r in self.receivers}
+            n = len(self.receivers)
+            while f"{kind}-{n}" in taken:
+                n += 1
+            name = f"{kind}-{n}"
         if kind == "queue":
             r = QueueEventReceiver(name, self, decoder,
                                    maxsize=cfg.get("maxsize", 1024))

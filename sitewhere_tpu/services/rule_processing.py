@@ -49,6 +49,7 @@ from sitewhere_tpu.kernel.bus import TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.models.registry import build_model
+from sitewhere_tpu.scoring.settle import SETTLE_POOL
 from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool, TenantSlot
 from sitewhere_tpu.scoring.server import ScoringConfig, ScoringSession
 
@@ -201,6 +202,55 @@ class RuleProcessingEngine(TenantEngine):
         if sink is None:
             raise RuntimeError("no model session configured")
         return sink.swap_params(params)
+
+    async def forecast_device(self, device_index: int) -> dict:
+        """Model FORWARD forecast for one device (the query/REST path;
+        config 3's capability surfaced): [H, Q] values in original
+        units plus the model's quantile levels. Raises LookupError when
+        the tenant's model has no forecast surface (e.g. zscore).
+
+        Windowing: the model's CONTEXT region must end at the newest
+        observation — for a windowed forecaster like the TFT (window =
+        context + horizon) the newest `context` points become the
+        context and the horizon tail is marked unobserved; feeding the
+        latest full window instead would return a hindcast of the last
+        H already-reported steps. Inference runs off the event loop
+        (first call traces/compiles — tens of seconds on a tunneled
+        chip must not stall the REST server)."""
+        if self.session is not None:
+            model, params = self.session.model, self.session.params
+        elif self.pool_slot is not None:
+            pool = self.pool_slot.pool
+            model = pool.model
+            params = pool.stack.get_params(self.tenant_id)
+        else:
+            raise LookupError("no model session configured")
+        fc = getattr(model, "forecast", None)
+        if fc is None:
+            raise LookupError(
+                f"model {self.model_name!r} has no forecast surface")
+        em = self.runtime.api("event-management").management(self.tenant_id)
+        w = model.cfg.window
+        ctx_len = getattr(model.cfg, "context", w)
+        x, valid = em.telemetry.window(
+            np.asarray([device_index]), w, mtype=self.scoring_cfg.mtype)
+        if ctx_len < w:
+            shifted = np.zeros_like(x)
+            vshift = np.zeros_like(valid)
+            shifted[:, :ctx_len] = x[:, w - ctx_len:]
+            vshift[:, :ctx_len] = valid[:, w - ctx_len:]
+            x, valid = shifted, vshift
+        loop = asyncio.get_running_loop()
+        out = (await loop.run_in_executor(
+            SETTLE_POOL, lambda: np.asarray(fc(params, x, valid))))[0]
+        return {
+            "device_index": device_index,
+            "horizon": int(out.shape[0]),
+            "quantiles": [float(q) for q in
+                          getattr(model.cfg, "quantiles", (0.5,))],
+            "forecast": [[float(v) for v in step] for step in out],
+            "history_points": int(valid[0].sum()),
+        }
 
 
 class RuleProcessor(BackgroundTaskComponent):

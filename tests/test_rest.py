@@ -703,3 +703,78 @@ def test_rest_full_event_type_surface(run):
             assert missing == []
 
     run(main())
+
+
+def test_rest_device_forecast(run):
+    """GET /api/devices/{token}/forecast surfaces the model plane's
+    forecast (config 3): TFT returns [H, Q] quantiles in original
+    units, LSTM a 1-step point forecast, zscore 404s."""
+
+    async def main():
+        from sitewhere_tpu.domain.model import DeviceType
+        from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme", "sections": {
+                           "rule-processing": {
+                               "model": "tft",
+                               "model_config": {"window": 16, "horizon": 4,
+                                                "hidden": 8},
+                               "buckets": [32], "capacity": 32}}})
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "zs", "sections": {
+                           "rule-processing": {"model": "zscore",
+                                               "model_config": {"window": 8},
+                                               "buckets": [32]}}})
+            for t in ("acme", "zs"):
+                dm = rt.api("device-management").management(t)
+                dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), 4)
+                em = rt.api("event-management").management(t)
+                sim = DeviceSimulator(SimConfig(num_devices=4, seed=1),
+                                      tenant_id=t)
+                for k in range(20):
+                    em.telemetry.append_measurements(sim.tick(t=60.0 * k)[0])
+
+            status, fc = await http(
+                port, "GET", "/api/devices/dev-1/forecast",
+                token=tok, tenant="acme")
+            assert status == 200, fc
+            assert fc["horizon"] == 4 and fc["quantiles"] == [0.1, 0.5, 0.9]
+            assert len(fc["forecast"]) == 4
+            assert all(len(step) == 3 for step in fc["forecast"])
+            med = fc["forecast"][0][1]
+            assert 0.0 < med < 60.0     # original units, plausible range
+            assert fc["history_points"] == 12  # context only: horizon tail unobserved
+
+            # zscore has no forecast surface
+            status, err = await http(
+                port, "GET", "/api/devices/dev-1/forecast",
+                token=tok, tenant="zs")
+            assert status == 404 and "no forecast" in err["error"]
+
+            # pooled tenant (shared stacked params): LSTM point forecast
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "pl", "sections": {
+                           "rule-processing": {
+                               "model": "lstm-stream",
+                               "model_config": {"window": 16},
+                               "buckets": [32], "shared": True}}})
+            dm = rt.api("device-management").management("pl")
+            dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), 4)
+            em = rt.api("event-management").management("pl")
+            sim = DeviceSimulator(SimConfig(num_devices=4, seed=2),
+                                  tenant_id="pl")
+            for k in range(20):
+                em.telemetry.append_measurements(sim.tick(t=60.0 * k)[0])
+            status, fc = await http(
+                port, "GET", "/api/devices/dev-2/forecast",
+                token=tok, tenant="pl")
+            assert status == 200, fc
+            assert fc["horizon"] == 1 and fc["quantiles"] == [0.5]
+            assert 0.0 < fc["forecast"][0][0] < 60.0
+
+    run(main())
