@@ -109,8 +109,6 @@ class LstmAnomalyModel:
         (`_predictions` feeds xn[:, :-1] because scoring compares
         pred(t) with the observed x_t; a forecast must not stop one
         step short or it merely reconstructs the newest observation)."""
-        from sitewhere_tpu.models.common import lstm_scan
-
         cfg = self.cfg
         xn, mu, sd = self._normalize(x, valid.astype(jnp.float32))
         seq = xn[:, :, None].astype(cfg.compute_dtype)

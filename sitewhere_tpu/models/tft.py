@@ -312,6 +312,15 @@ class TftForecaster:
         _, attn = self._forward(params, xn, valid)
         return attn
 
+    def forecast_with_attention(self, params: dict, x: jax.Array,
+                                valid: jax.Array):
+        """(forecast [B, H, Q] in original units, attention
+        [B, heads, H, W]) from ONE forward pass — the query surface
+        uses this so attention doesn't double the compute/compile."""
+        xn, mu, sd = self._normalize(x, valid)
+        quants, attn = self._forward(params, xn, valid)
+        return quants * sd[..., None] + mu[..., None], attn
+
     def score(self, params: dict, x: jax.Array, valid: jax.Array) -> jax.Array:
         """Anomaly score: worst violation of the predicted outer-quantile
         interval by the observed horizon tail, in interval half-widths

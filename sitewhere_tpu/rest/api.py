@@ -569,8 +569,11 @@ class RestServer(LifecycleComponent):
         units. 404 when the tenant's model has no forecast."""
         device = self._device_by_token(req, req.params["token"])
         engine = self._engine(req, "rule-processing")
+        want_attn = req.qp("attention", "false").lower() \
+            in ("1", "true", "yes")
         try:
-            return await engine.forecast_device(device.index)
+            return await engine.forecast_device(
+                device.index, include_attention=want_attn)
         except LookupError as exc:
             raise HttpError(404, str(exc)) from exc
 
@@ -647,6 +650,10 @@ class RestServer(LifecycleComponent):
 
         idx = self._assignment_device_index(req)
         b = req.json()
+        if b.get("eventDate", 0) is None:
+            # explicit JSON null = "unset" (common serializer output);
+            # coalesce to now in ONE place for every event builder
+            del b["eventDate"]
         try:
             batch = build(idx, b, self._tenant_id(req))
         except (TypeError, ValueError) as exc:
@@ -711,8 +718,8 @@ class RestServer(LifecycleComponent):
             message=b.get("message", ""),
             level=level,
             source=b.get("source", "rest"),
-            event_date=(_time.time() if b.get("eventDate") is None
-                        else b["eventDate"]))
+            event_date=(b["eventDate"] if b.get("eventDate") is not None
+                        else _time.time()))
         out = await self._em(req).add_alerts([alert])
         return event_to_dict(out[0])
 

@@ -6,3 +6,9 @@ on this one pool of workers instead of blocking the event loop."""
 from concurrent.futures import ThreadPoolExecutor
 
 SETTLE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="swx-settle")
+
+# query-path inference (REST forecasts, ad-hoc scoring) runs on its own
+# small pool: a first-call model compile blocks its worker for tens of
+# seconds on a tunneled chip and must never starve the scoring plane's
+# settle pipeline above
+QUERY_POOL = ThreadPoolExecutor(max_workers=2, thread_name_prefix="swx-query")

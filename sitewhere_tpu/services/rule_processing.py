@@ -49,7 +49,7 @@ from sitewhere_tpu.kernel.bus import TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.models.registry import build_model
-from sitewhere_tpu.scoring.settle import SETTLE_POOL
+from sitewhere_tpu.scoring.settle import QUERY_POOL
 from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool, TenantSlot
 from sitewhere_tpu.scoring.server import ScoringConfig, ScoringSession
 
@@ -203,7 +203,8 @@ class RuleProcessingEngine(TenantEngine):
             raise RuntimeError("no model session configured")
         return sink.swap_params(params)
 
-    async def forecast_device(self, device_index: int) -> dict:
+    async def forecast_device(self, device_index: int,
+                              include_attention: bool = False) -> dict:
         """Model FORWARD forecast for one device (the query/REST path;
         config 3's capability surfaced): [H, Q] values in original
         units plus the model's quantile levels. Raises LookupError when
@@ -241,9 +242,20 @@ class RuleProcessingEngine(TenantEngine):
             vshift[:, :ctx_len] = valid[:, w - ctx_len:]
             x, valid = shifted, vshift
         loop = asyncio.get_running_loop()
-        out = (await loop.run_in_executor(
-            SETTLE_POOL, lambda: np.asarray(fc(params, x, valid))))[0]
-        return {
+        both_fn = getattr(model, "forecast_with_attention", None)
+        attn = None
+        if include_attention and both_fn is not None:
+            # one forward pass serves both outputs (forecast and
+            # attention share _forward; two entry points would double
+            # the compute AND the first-call compile)
+            out, attn = await loop.run_in_executor(
+                QUERY_POOL, lambda: tuple(
+                    np.asarray(a) for a in both_fn(params, x, valid)))
+            out, attn = out[0], attn[0]
+        else:
+            out = (await loop.run_in_executor(
+                QUERY_POOL, lambda: np.asarray(fc(params, x, valid))))[0]
+        result = {
             "device_index": device_index,
             "horizon": int(out.shape[0]),
             "quantiles": [float(q) for q in
@@ -251,6 +263,12 @@ class RuleProcessingEngine(TenantEngine):
             "forecast": [[float(v) for v in step] for step in out],
             "history_points": int(valid[0].sum()),
         }
+        if attn is not None:
+            # interpretability surface (TFT's interpretable multi-head
+            # attention, Lim et al. §4.4): which history positions each
+            # horizon step attended to — [heads, H, W]
+            result["attention"] = attn.tolist()
+        return result
 
 
 class RuleProcessor(BackgroundTaskComponent):

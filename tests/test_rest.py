@@ -749,6 +749,15 @@ def test_rest_device_forecast(run):
             med = fc["forecast"][0][1]
             assert 0.0 < med < 60.0     # original units, plausible range
             assert fc["history_points"] == 12  # context only: horizon tail unobserved
+            assert "attention" not in fc
+            status, fc2 = await http(
+                port, "GET", "/api/devices/dev-1/forecast?attention=true",
+                token=tok, tenant="acme")
+            assert status == 200
+            attn = fc2["attention"]      # [heads, H, W]
+            assert len(attn[0]) == 4 and len(attn[0][0]) == 16
+            import math
+            assert all(math.isfinite(w) for w in attn[0][0])
 
             # zscore has no forecast surface
             status, err = await http(
