@@ -375,12 +375,55 @@ async def cmd_train(args) -> int:
     return 0
 
 
+def _select_backend(force_cpu: bool, probe_timeout: float = 75.0) -> str:
+    """Pick the JAX backend BEFORE the parent touches jax.
+
+    A hung accelerator tunnel blocks `jax.devices()` forever and wedges
+    the process's global backend (the bench supervisor's round-3
+    lesson) — so probe in a throwaway SUBPROCESS with a hard timeout
+    and only let the parent initialize the accelerator after the probe
+    answers; otherwise pin CPU with a warning instead of hanging an
+    interactive command."""
+    import subprocess
+
+    if force_cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        return "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, timeout=probe_timeout)
+        platform = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        if proc.returncode == 0 and platform:
+            return platform
+        reason = f"probe rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = f"probe hung >{probe_timeout:.0f}s (tunnel down?)"
+    except Exception as exc:  # noqa: BLE001 - fall back, don't hang
+        reason = str(exc)
+    print(f"swx: accelerator unavailable ({reason}); running on CPU",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="swx")
     parser.add_argument("-v", "--verbose", action="store_true")
+    # shared by the top level AND every subcommand so `swx run --cpu`
+    # and `swx --cpu run` both work (parse_known_args would otherwise
+    # silently swallow a post-subcommand --cpu into `extra`)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--cpu", action="store_true",
+                        help="pin the CPU backend (skip the accelerator "
+                             "probe)")
+    parser.add_argument("--cpu", action="store_true",
+                        help=argparse.SUPPRESS)
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p_run = sub.add_parser("run", help="run a full instance (or a subset "
+    p_run = sub.add_parser("run", parents=[common], help="run a full instance (or a subset "
                                        "of services against a wire bus)")
     p_run.add_argument("--config", help="instance YAML")
     p_run.add_argument("--port", type=int, help="REST port")
@@ -402,7 +445,7 @@ def main(argv=None) -> int:
                        help="shared secret for wire bus/API connections "
                             "(default: SWX_WIRE_SECRET env)")
 
-    p_bus = sub.add_parser("serve-bus", help="run the wire bus broker")
+    p_bus = sub.add_parser("serve-bus", parents=[common], help="run the wire bus broker")
     p_bus.add_argument("--host", default="127.0.0.1")
     p_bus.add_argument("--port", type=int, default=47900)
     p_bus.add_argument("--partitions", type=int, default=4)
@@ -412,7 +455,7 @@ def main(argv=None) -> int:
                             "peer (default: SWX_WIRE_SECRET env; unset = "
                             "open, loopback/test use)")
 
-    p_sim = sub.add_parser("simulate", help="stream SWB1 at a TCP gateway")
+    p_sim = sub.add_parser("simulate", parents=[common], help="stream SWB1 at a TCP gateway")
     p_sim.add_argument("--host", default="127.0.0.1")
     p_sim.add_argument("--port", type=int, default=47800)
     p_sim.add_argument("--devices", type=int, default=1000)
@@ -422,14 +465,14 @@ def main(argv=None) -> int:
                        help="batches per second (0 = unthrottled)")
     p_sim.add_argument("--anomaly-rate", type=float, default=0.0)
 
-    p_demo = sub.add_parser("demo", help="one-process end-to-end demo")
+    p_demo = sub.add_parser("demo", parents=[common], help="one-process end-to-end demo")
     p_demo.add_argument("--devices", type=int, default=1000)
     p_demo.add_argument("--seconds", type=float, default=5.0)
     p_demo.add_argument("--port", type=int)
 
-    sub.add_parser("bench", help="run the benchmark (see bench.py flags)")
+    sub.add_parser("bench", parents=[common], help="run the benchmark (see bench.py flags)")
 
-    p_train = sub.add_parser("train", help="train a model (optionally "
+    p_train = sub.add_parser("train", parents=[common], help="train a model (optionally "
                                            "multi-host via --distributed)")
     p_train.add_argument("--model", default="lstm")
     p_train.add_argument("--window", type=int, default=64)
@@ -455,7 +498,16 @@ def main(argv=None) -> int:
     if args.cmd == "bench":
         import subprocess
 
-        return subprocess.call([sys.executable, "bench.py", *extra])
+        return subprocess.call([sys.executable, "bench.py", *extra,
+                                *(["--force-cpu"] if args.cpu else [])])
+    if args.cmd in ("run", "demo", "train"):
+        # model-plane commands: resolve the backend first so a dead
+        # tunnel degrades to CPU instead of hanging the command
+        plat = _select_backend(args.cpu)
+        if plat == "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
     coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
             "train": cmd_train, "serve-bus": cmd_serve_bus}[args.cmd]
     return asyncio.run(coro(args))

@@ -243,6 +243,9 @@ class RuleProcessingEngine(TenantEngine):
             x, valid = shifted, vshift
         loop = asyncio.get_running_loop()
         both_fn = getattr(model, "forecast_with_attention", None)
+        if include_attention and both_fn is None:
+            raise LookupError(
+                f"model {self.model_name!r} has no attention surface")
         attn = None
         if include_attention and both_fn is not None:
             # one forward pass serves both outputs (forecast and

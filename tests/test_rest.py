@@ -785,5 +785,10 @@ def test_rest_device_forecast(run):
             assert status == 200, fc
             assert fc["horizon"] == 1 and fc["quantiles"] == [0.5]
             assert 0.0 < fc["forecast"][0][0] < 60.0
+            # LSTM has forecast but no attention: explicit 404
+            status, err = await http(
+                port, "GET", "/api/devices/dev-2/forecast?attention=1",
+                token=tok, tenant="pl")
+            assert status == 404 and "attention" in err["error"]
 
     run(main())
