@@ -109,9 +109,15 @@ def pallas_ok(batch: int, layers: int, cdt=jnp.bfloat16) -> bool:
     on a real TPU backend for tile-divisible batches (bench buckets are
     powers of two ≥ 256). Everything else — including a model built
     with a non-bf16 compute_dtype, whose matmuls the kernel would
-    silently narrow — takes the reference path."""
+    silently narrow — takes the reference path. SWX_DISABLE_PALLAS=1 is
+    the operator escape hatch."""
+    import os
+
     return (layers == 1 and batch >= B_TILE and batch % B_TILE == 0
             and cdt == jnp.bfloat16
+            # explicit compare (SWX_NATIVE convention): only "1"-ish
+            # values disable; =0 keeps the kernel enabled
+            and os.environ.get("SWX_DISABLE_PALLAS", "0") in ("", "0")
             and jax.default_backend() == "tpu")
 
 

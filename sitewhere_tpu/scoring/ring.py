@@ -42,6 +42,9 @@ class DeviceRing:
         # per-event device→host payload); settle upcasts on assignment
         self.score_dtype = jnp.dtype(score_dtype) if score_dtype else None
         self._update_score_fns: dict[tuple, Callable] = {}
+        # fused-scorer viability is per backend, not per shape: one
+        # failed Pallas compile disables it for every bucket/growth
+        self._fused_broken = False
         self.faulted = False  # True after a failed dispatch donated state away
         self._alloc(self.capacity)
 
@@ -89,13 +92,15 @@ class DeviceRing:
 
     # -- compiled steps ----------------------------------------------------
 
-    def _build_update_score(self, model, cap: int, bucket: int) -> Callable:
+    def _build_update_score(self, model, cap: int, bucket: int,
+                            prefer_fused: bool = True) -> Callable:
         w = self.window
         out_dtype = self.score_dtype
         # the dedicated ring is never vmapped, so it may take the
         # model's fused (Pallas) scorer when one exists; the stacked
         # ring stays on `score` (lax.scan batches under vmap)
-        score = getattr(model, "score_fused", model.score)
+        score = (getattr(model, "score_fused", model.score)
+                 if prefer_fused else model.score)
 
         def step(params, vals, cnt, cur, dev, v):
             pos = cur[dev]
@@ -128,10 +133,40 @@ class DeviceRing:
         (async — caller settles off-loop)."""
         key = (self.capacity, bucket)
         fn = self._update_score_fns.get(key)
-        if fn is None:
-            fn = self._update_score_fns[key] = \
-                self._build_update_score(model, self.capacity, bucket)
         pdev, pv = self._pad(dev, v, bucket)
+        if fn is None:
+            from sitewhere_tpu.ops.lstm_kernel import pallas_ok
+
+            prefer = (hasattr(model, "score_fused")
+                      and not self._fused_broken
+                      and pallas_ok(bucket,
+                                    getattr(model.cfg, "layers", 0),
+                                    getattr(model.cfg, "compute_dtype",
+                                            None)))
+            fn = self._build_update_score(model, self.capacity, bucket,
+                                          prefer_fused=prefer)
+            if prefer:
+                # compile-probe (AOT lower+compile executes nothing, so
+                # donation consumes no buffers): if the fused (Pallas)
+                # path fails to compile on THIS backend, fall back to
+                # the scan scorer instead of wedging warmup — the fused
+                # kernel is an optimization, never a dependency. On
+                # success the Compiled object is kept (no re-compile at
+                # dispatch); on failure the verdict is remembered so
+                # other buckets skip the doomed attempt.
+                try:
+                    fn = fn.lower(params, self.values, self.count,
+                                  self.cursor, pdev, pv).compile()
+                except Exception:  # noqa: BLE001 - any compile failure
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "fused scorer failed to compile; using the "
+                        "reference scan path", exc_info=True)
+                    self._fused_broken = True
+                    fn = self._build_update_score(
+                        model, self.capacity, bucket, prefer_fused=False)
+            self._update_score_fns[key] = fn
         try:
             self.values, self.count, self.cursor, scores = fn(
                 params, self.values, self.count, self.cursor, pdev, pv)

@@ -109,3 +109,65 @@ def test_pallas_ok_predicate():
     assert not pallas_ok(B_TILE, 1, jnp.float32)
     with pytest.raises(TypeError):
         pallas_ok()                        # args are required
+
+
+def test_ring_falls_back_when_fused_scorer_fails_to_compile(monkeypatch):
+    """A fused scorer that fails at trace/compile time must degrade to
+    the reference scan path, not wedge warmup (the kernel is an
+    optimization, never a dependency); the broken verdict is remembered
+    ring-wide so other buckets skip the doomed compile."""
+    from sitewhere_tpu.ops import lstm_kernel
+    from sitewhere_tpu.scoring.ring import DeviceRing
+
+    # force the fused gate open (CPU would normally skip the probe)
+    monkeypatch.setattr(lstm_kernel, "pallas_ok", lambda *a, **k: True)
+
+    model = LstmAnomalyModel(LstmConfig(window=16))
+    params = model.init(jax.random.PRNGKey(0))
+
+    calls = {"fused": 0}
+
+    def broken_fused(p, x, valid):
+        calls["fused"] += 1
+        raise RuntimeError("mosaic said no")
+
+    model.score_fused = broken_fused
+    ring = DeviceRing(window=16, capacity=64)
+    dev = np.arange(8, dtype=np.int32)
+    v = np.ones(8, np.float32)
+    scores = np.asarray(ring.update_and_score(model, params, dev, v, 64))
+    assert calls["fused"] == 1          # probed once, then abandoned
+    assert scores.shape == (64,) and np.isfinite(scores[:8]).all()
+    assert not ring.faulted and ring._fused_broken
+    # second flush reuses the cached fallback without re-probing
+    ring.update_and_score(model, params, dev, v, 64)
+    assert calls["fused"] == 1
+    # a NEW bucket skips the doomed probe entirely (verdict remembered)
+    ring.update_and_score(model, params, dev[:4], v[:4], 32)
+    assert calls["fused"] == 1
+
+
+def test_ring_probe_keeps_compiled_fn(monkeypatch):
+    """When the fused path compiles, the probe's Compiled object is
+    kept — dispatch must not pay a second identical compile — and the
+    scores match the plain scan path."""
+    from sitewhere_tpu.ops import lstm_kernel
+    from sitewhere_tpu.scoring.ring import DeviceRing
+
+    monkeypatch.setattr(lstm_kernel, "pallas_ok", lambda *a, **k: True)
+    model = LstmAnomalyModel(LstmConfig(window=16))
+    params = model.init(jax.random.PRNGKey(0))
+    # a fused scorer with a compilable body (the monkeypatched gate
+    # would otherwise push score_fused onto the real Pallas path, which
+    # cannot compile on CPU): the probe machinery runs end to end
+    model.score_fused = model.score
+    ring = DeviceRing(window=16, capacity=64)
+    dev = np.arange(8, dtype=np.int32)
+    v = np.ones(8, np.float32)
+    scores = np.asarray(ring.update_and_score(model, params, dev, v, 64))
+    fn = ring._update_score_fns[(ring.capacity, 64)]
+    assert not hasattr(fn, "lower")     # AOT Compiled, not a jit wrapper
+    ref = DeviceRing(window=16, capacity=64)
+    ref_scores = np.asarray(ref.update_and_score(
+        LstmAnomalyModel(LstmConfig(window=16)), params, dev, v, 64))
+    np.testing.assert_allclose(scores[:8], ref_scores[:8], atol=1e-5)
