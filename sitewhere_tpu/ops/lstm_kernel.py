@@ -115,9 +115,10 @@ def pallas_ok(batch: int, layers: int, cdt=jnp.bfloat16) -> bool:
 
     return (layers == 1 and batch >= B_TILE and batch % B_TILE == 0
             and cdt == jnp.bfloat16
-            # explicit compare (SWX_NATIVE convention): only "1"-ish
-            # values disable; =0 keeps the kernel enabled
-            and os.environ.get("SWX_DISABLE_PALLAS", "0") in ("", "0")
+            # explicit truthy compare: only affirmative values disable;
+            # "", "0", "false", "no", "off" all keep the kernel enabled
+            and os.environ.get("SWX_DISABLE_PALLAS", "").lower()
+            not in ("1", "true", "yes", "on")
             and jax.default_backend() == "tpu")
 
 

@@ -339,6 +339,9 @@ class InMemoryDeviceManagement:
     def get_zone(self, id: str) -> Optional[Zone]:
         return self.zones.get(id)
 
+    def get_zone_by_token(self, token: str) -> Optional[Zone]:
+        return self.zones.get_by_token(token)
+
     def list_zones(self, area_id: Optional[str] = None) -> list[Zone]:
         items = self.zones.values()
         if area_id is not None:

@@ -124,6 +124,12 @@ class RuleProcessingEngine(TenantEngine):
         self.scripts = ScriptManager(self.tenant_id)
         for name, source in cfg.get("scripts", {}).items():
             self.put_script(name, source)
+        fences = cfg.get("geofences")
+        if fences:
+            from sitewhere_tpu.services.geofence import GeofenceHook
+
+            self.add_hook("geofence",
+                          GeofenceHook(self.runtime, self.tenant_id, fences))
         self.processor = RuleProcessor(self)
         self.add_child(self.processor)
 

@@ -646,3 +646,84 @@ def test_presence_monitor_marks_missing_and_recovers(run):
             assert len(ds.presence.missing) == 4
 
     run(main())
+
+
+def test_geofence_point_in_polygon_unit():
+    from sitewhere_tpu.services.geofence import points_in_polygon
+
+    square = ((0.0, 0.0), (0.0, 10.0), (10.0, 10.0), (10.0, 0.0))
+    lat = np.array([5.0, 15.0, 0.5, 9.9, -1.0])
+    lon = np.array([5.0, 5.0, 0.5, 9.9, 5.0])
+    got = points_in_polygon(lat, lon, square)
+    assert got.tolist() == [True, False, True, True, False]
+    # concave polygon (an L): the notch is outside
+    ell = ((0.0, 0.0), (0.0, 10.0), (4.0, 10.0), (4.0, 4.0),
+           (10.0, 4.0), (10.0, 0.0))
+    lat = np.array([2.0, 8.0, 8.0])
+    lon = np.array([8.0, 8.0, 2.0])
+    assert points_in_polygon(lat, lon, ell).tolist() == [True, False, True]
+    # degenerate (<3 vertices): nothing is inside
+    assert not points_in_polygon(lat, lon, ((0, 0), (1, 1))).any()
+
+
+def test_geofence_zone_transitions_emit_alerts(run):
+    """Location events crossing a zone boundary produce enter/exit
+    alerts ONCE per transition (a device dwelling inside doesn't
+    re-alert every tick)."""
+
+    async def main():
+        from sitewhere_tpu.domain.batch import BatchContext, LocationBatch
+        from sitewhere_tpu.domain.model import Zone
+
+        sections = {"rule-processing": {
+            "model": "zscore", "model_config": {"window": 8},
+            "buckets": [64], "batch_window_ms": 1.0,
+            "geofences": [{"zone": "dock", "alert_on": "both",
+                           "level": "error"}]}}
+        async with full_instance(sections, num_devices=4) as rt:
+            dm = rt.api("device-management").management("acme")
+            area = dm.list_areas()[0] if dm.list_areas() else None
+            dm.create_zone(Zone(token="dock", name="Dock",
+                                area_id=area.id if area else "",
+                                bounds=((0.0, 0.0), (0.0, 10.0),
+                                        (10.0, 10.0), (10.0, 0.0))))
+            em = rt.api("event-management").management("acme")
+            bus = rt.bus
+            topic = rt.naming.tenant_topic("acme", "outbound-enriched-events")
+
+            def loc_batch(dev, lat, lon, ts):
+                return LocationBatch(
+                    BatchContext(tenant_id="acme", source="test"),
+                    np.asarray(dev, np.uint32),
+                    np.asarray(lat, np.float64),
+                    np.asarray(lon, np.float64),
+                    np.zeros(len(dev), np.float32),
+                    np.asarray(ts, np.float64))
+
+            # devices 0,1 enter; 2 stays outside
+            await bus.produce(topic, loc_batch(
+                [0, 1, 2], [5.0, 2.0, 50.0], [5.0, 2.0, 50.0],
+                [1.0, 1.0, 1.0]))
+            await wait_until(
+                lambda: len([a for a in em.list_alerts()
+                             if a.type == "zone.enter"]) == 2, timeout=10.0)
+            # device 0 moves WITHIN the zone: no new alert
+            await bus.produce(topic, loc_batch([0], [6.0], [6.0], [2.0]))
+            await asyncio.sleep(0.3)
+            enters = [a for a in em.list_alerts() if a.type == "zone.enter"]
+            assert len(enters) == 2
+            # device 0 exits
+            await bus.produce(topic, loc_batch([0], [60.0], [6.0], [3.0]))
+            await wait_until(
+                lambda: any(a.type == "zone.exit"
+                            for a in em.list_alerts()), timeout=10.0)
+            exits = [a for a in em.list_alerts() if a.type == "zone.exit"]
+            assert len(exits) == 1
+            assert exits[0].level.name == "ERROR"
+            # re-enter alerts again (transition, not state)
+            await bus.produce(topic, loc_batch([0], [5.0], [5.0], [4.0]))
+            await wait_until(
+                lambda: len([a for a in em.list_alerts()
+                             if a.type == "zone.enter"]) == 3, timeout=10.0)
+
+    run(main())
