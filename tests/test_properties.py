@@ -17,6 +17,8 @@ INVARIANTS under arbitrary operation sequences (hypothesis):
 
 import asyncio
 
+import numpy as np
+
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 from sitewhere_tpu.kernel.bus import EventBus
@@ -269,3 +271,85 @@ def test_tenant_respin_during_update_lands_on_last_config(run):
         await rt.stop()
 
     run(main())
+
+
+# -- geofence polygon containment (hypothesis) -------------------------------
+
+
+@given(
+    center=st.tuples(st.floats(-80, 80), st.floats(-170, 170)),
+    radius=st.floats(0.1, 5.0),
+    n_vertices=st.integers(3, 12),
+    points=st.lists(st.tuples(st.floats(0.0, 2.0), st.floats(0, 2 * 3.14159)),
+                    min_size=1, max_size=20),
+)
+@settings(max_examples=60, deadline=None)
+def test_point_in_polygon_regular_polygon_radius_property(
+        center, radius, n_vertices, points):
+    """For a REGULAR convex polygon, containment is decidable by radius
+    alone (away from the boundary band): points well inside the
+    inscribed circle are in, points beyond the circumscribed circle are
+    out — a geometry-only oracle independent of the ray-casting code."""
+    import math
+
+    from sitewhere_tpu.services.geofence import points_in_polygon
+
+    cy, cx = center
+    verts = tuple(
+        (cy + radius * math.sin(2 * math.pi * k / n_vertices),
+         cx + radius * math.cos(2 * math.pi * k / n_vertices))
+        for k in range(n_vertices))
+    r_in = radius * math.cos(math.pi / n_vertices)   # inscribed
+    lat, lon, expect = [], [], []
+    for rf, theta in points:
+        rr = rf * radius
+        # skip the ambiguous band between inscribed and circumscribed
+        if 0.95 * r_in < rr < 1.05 * radius:
+            continue
+        lat.append(cy + rr * math.sin(theta))
+        lon.append(cx + rr * math.cos(theta))
+        expect.append(rr < r_in)
+    if not lat:
+        return
+    got = points_in_polygon(np.asarray(lat), np.asarray(lon), verts)
+    assert got.tolist() == expect
+
+
+@given(
+    verts=st.lists(st.tuples(st.integers(-50, 50), st.integers(-50, 50)),
+                   min_size=3, max_size=10),
+    pts=st.lists(st.tuples(st.integers(-60, 60), st.integers(-60, 60)),
+                 min_size=1, max_size=10),
+    shift=st.tuples(st.integers(-100, 100), st.integers(-100, 100)),
+)
+@settings(max_examples=60, deadline=None)
+def test_point_in_polygon_translation_invariant(verts, pts, shift):
+    """Containment is invariant under translating polygon AND points —
+    catches coordinate-mixing bugs for arbitrary (self-intersecting
+    included) polygons. Grid coordinates keep the arithmetic exact so
+    boundary points (where ray casting is documented as unspecified)
+    can be excluded exactly."""
+    from sitewhere_tpu.services.geofence import points_in_polygon
+
+    def on_boundary(p):
+        py, px = p
+        e = len(verts)
+        for k in range(e):
+            ay, ax = verts[k]
+            by, bx = verts[(k + 1) % e]
+            cross = (bx - ax) * (py - ay) - (by - ay) * (px - ax)
+            if cross == 0 and min(ay, by) <= py <= max(ay, by) \
+                    and min(ax, bx) <= px <= max(ax, bx):
+                return True
+        return False
+
+    keep = [p for p in pts if not on_boundary(p)]
+    if not keep:
+        return
+    lat = np.asarray([float(p[0]) for p in keep])
+    lon = np.asarray([float(p[1]) for p in keep])
+    a = points_in_polygon(lat, lon, tuple(verts))
+    dy, dx = shift
+    moved = tuple((y + dy, x + dx) for y, x in verts)
+    b = points_in_polygon(lat + dy, lon + dx, moved)
+    assert a.tolist() == b.tolist()
