@@ -246,29 +246,42 @@ async def cmd_run(args) -> int:
 
 
 async def cmd_simulate(args) -> int:
+    from sitewhere_tpu.sim.clients import make_sender
     from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
 
     sim = DeviceSimulator(SimConfig(num_devices=args.devices,
                                     anomaly_rate=args.anomaly_rate),
                           tenant_id=args.tenant)
-    reader, writer = await asyncio.open_connection(args.host, args.port)
+    kw = {}
+    if args.protocol == "mqtt":
+        kw = {"topic": args.topic, "client_id": args.client_id,
+              "username": args.username, "password": args.password}
+    elif args.protocol == "coap":
+        kw = {"path": args.topic}
+    elif args.protocol == "websocket":
+        kw = {"client_id": args.client_id, "token": args.password}
+    elif args.protocol == "amqp":
+        kw = {"routing_key": args.topic,
+              "username": args.username or "guest",
+              "password": args.password or "guest"}
+    sender = make_sender(args.protocol, args.host, args.port, **kw)
+    await sender.connect()
     sent = 0
     t0 = time.monotonic()
     interval = 1.0 / args.rate if args.rate else 0.0
     try:
         while args.seconds <= 0 or time.monotonic() - t0 < args.seconds:
             payload, _ = sim.payload()
-            writer.write(len(payload).to_bytes(4, "little") + payload)
-            await writer.drain()
+            await sender.send(payload)
             sent += args.devices
             if interval:
                 await asyncio.sleep(interval)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
-        writer.close()
+        await sender.close()
     rate = sent / max(time.monotonic() - t0, 1e-9)
-    print(f"sent {sent} events ({rate:,.0f}/s)")
+    print(f"sent {sent} events over {args.protocol} ({rate:,.0f}/s)")
     return 0
 
 
@@ -455,15 +468,26 @@ def main(argv=None) -> int:
                             "peer (default: SWX_WIRE_SECRET env; unset = "
                             "open, loopback/test use)")
 
-    p_sim = sub.add_parser("simulate", parents=[common], help="stream SWB1 at a TCP gateway")
+    p_sim = sub.add_parser("simulate", parents=[common],
+                           help="stream SWB1 at any ingest endpoint")
     p_sim.add_argument("--host", default="127.0.0.1")
     p_sim.add_argument("--port", type=int, default=47800)
+    p_sim.add_argument("--protocol", default="tcp",
+                       choices=["tcp", "mqtt", "coap", "websocket", "amqp"],
+                       help="which hosted endpoint to drive")
     p_sim.add_argument("--devices", type=int, default=1000)
     p_sim.add_argument("--tenant", default="default")
     p_sim.add_argument("--seconds", type=float, default=10.0)
     p_sim.add_argument("--rate", type=float, default=10.0,
                        help="batches per second (0 = unthrottled)")
     p_sim.add_argument("--anomaly-rate", type=float, default=0.0)
+    p_sim.add_argument("--topic", default="telemetry",
+                       help="MQTT topic / CoAP path / AMQP routing key")
+    p_sim.add_argument("--client-id", default="swx-sim",
+                       help="MQTT/WebSocket client id")
+    p_sim.add_argument("--username", help="MQTT/AMQP username")
+    p_sim.add_argument("--password",
+                       help="MQTT/AMQP password; WebSocket bearer token")
 
     p_demo = sub.add_parser("demo", parents=[common], help="one-process end-to-end demo")
     p_demo.add_argument("--devices", type=int, default=1000)

@@ -727,3 +727,39 @@ def test_geofence_zone_transitions_emit_alerts(run):
                              if a.type == "zone.enter"]) == 3, timeout=10.0)
 
     run(main())
+
+
+def test_simulator_clients_drive_every_protocol(run):
+    """sim/clients.py senders (the `swx simulate --protocol ...`
+    machinery) deliver SWB1 through every hosted endpoint: TCP, MQTT,
+    CoAP, WebSocket, AMQP — same payload, same pipeline."""
+
+    async def main():
+        from sitewhere_tpu.sim.clients import make_sender
+        from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+        sections = {"event-sources": {"receivers": [
+            {"kind": "queue", "decoder": "swb1", "name": "default"},
+            {"kind": "tcp", "decoder": "swb1", "name": "tcp"},
+            {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"},
+            {"kind": "coap", "decoder": "swb1", "name": "coap"},
+            {"kind": "websocket", "decoder": "swb1", "name": "websocket"},
+            {"kind": "amqp", "decoder": "swb1", "name": "amqp"}]}}
+        async with full_instance(sections, num_devices=10) as rt:
+            em = rt.api("event-management").management("acme")
+            sources = rt.api("event-sources").engine("acme")
+            sim = DeviceSimulator(SimConfig(num_devices=10), tenant_id="acme")
+            expected = 0
+            for k, proto in enumerate(
+                    ("tcp", "mqtt", "coap", "websocket", "amqp")):
+                port = sources.receiver(proto).port
+                sender = make_sender(proto, "127.0.0.1", port)
+                await sender.connect()
+                await sender.send(sim.payload(t=60.0 * k)[0])
+                expected += 10
+                await wait_until(
+                    lambda n=expected: em.telemetry.total_events == n,
+                    timeout=10.0)
+                await sender.close()
+
+    run(main())
