@@ -429,7 +429,11 @@ def main(argv=None) -> int:
     # and `swx --cpu run` both work (parse_known_args would otherwise
     # silently swallow a post-subcommand --cpu into `extra`)
     common = argparse.ArgumentParser(add_help=False)
+    # default=SUPPRESS: a subcommand that DOESN'T carry --cpu must not
+    # write False over a pre-subcommand `swx --cpu <cmd>` (argparse
+    # subparsers re-apply their defaults onto the shared namespace)
     common.add_argument("--cpu", action="store_true",
+                        default=argparse.SUPPRESS,
                         help="pin the CPU backend (skip the accelerator "
                              "probe)")
     parser.add_argument("--cpu", action="store_true",

@@ -16,6 +16,28 @@ import os
 import struct
 from typing import Optional
 
+from sitewhere_tpu.services.amqp import _longstr, _method, _shortstr
+from sitewhere_tpu.services.coap import CODE_POST, TYPE_NON, build_request
+from sitewhere_tpu.services.mqtt import _packet as _mqtt_packet
+
+
+async def _close_writer(writer: Optional[asyncio.StreamWriter]) -> None:
+    """Flush-then-close: writer.close() alone can drop buffered tail
+    data when the event loop tears down right after cmd_simulate
+    returns (the last ~64 KB would be counted as sent but never reach
+    the wire)."""
+    if writer is None:
+        return
+    try:
+        await writer.drain()
+    except ConnectionError:
+        pass
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionError, asyncio.CancelledError):
+        pass
+
 
 class TcpSender:
     """u32-LE length prefix + body (the gateway protocol)."""
@@ -32,8 +54,7 @@ class TcpSender:
         await self._writer.drain()
 
     async def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        await _close_writer(self._writer)
 
 
 class MqttSender:
@@ -56,15 +77,9 @@ class MqttSender:
 
     @staticmethod
     def _packet(ptype: int, body: bytes) -> bytes:
-        # variable-length remaining-length encoding
-        rem, n = bytearray(), len(body)
-        while True:
-            d = n % 128
-            n //= 128
-            rem.append(d | (0x80 if n else 0))
-            if not n:
-                break
-        return bytes([ptype]) + bytes(rem) + body
+        # server-side framing helper reused (services/mqtt.py): one
+        # remaining-length encoder to interoperate with
+        return _mqtt_packet(ptype >> 4, ptype & 0x0F, body)
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
@@ -94,7 +109,7 @@ class MqttSender:
     async def close(self) -> None:
         if self._writer is not None:
             self._writer.write(self._packet(0xE0, b""))   # DISCONNECT
-            self._writer.close()
+        await _close_writer(self._writer)
 
 
 class CoapSender:
@@ -127,8 +142,6 @@ class CoapSender:
             _P, remote_addr=(self.host, self.port))
 
     async def send(self, payload: bytes) -> None:
-        from sitewhere_tpu.services.coap import CODE_POST, TYPE_NON, build_request
-
         if self._error is not None:
             raise ConnectionError(f"coap transport error: {self._error}")
         if len(payload) > self.MAX_PAYLOAD:
@@ -194,8 +207,7 @@ class WebSocketSender:
         await self._writer.drain()
 
     async def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        await _close_writer(self._writer)
 
 
 class AmqpSender:
@@ -210,20 +222,14 @@ class AmqpSender:
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
 
-    @staticmethod
-    def _ss(s: str) -> bytes:
-        b = s.encode()
-        return bytes([len(b)]) + b
+    # argument encoders reused from the server module (services/amqp.py)
+    _ss = staticmethod(_shortstr)
+    _method = staticmethod(_method)
 
     @staticmethod
     def _frame(ftype: int, channel: int, payload: bytes) -> bytes:
         return (struct.pack(">BHI", ftype, channel, len(payload))
                 + payload + b"\xce")
-
-    @classmethod
-    def _method(cls, class_id: int, method_id: int,
-                args: bytes = b"") -> bytes:
-        return struct.pack(">HH", class_id, method_id) + args
 
     async def _expect(self, class_id: int, method_id: int) -> bytes:
         while True:
@@ -249,7 +255,7 @@ class AmqpSender:
             + self.password.encode()
         w.write(self._frame(1, 0, self._method(
             10, 11, struct.pack(">I", 0) + self._ss("PLAIN")
-            + struct.pack(">I", len(plain)) + plain + self._ss("en_US"))))
+            + _longstr(plain) + self._ss("en_US"))))
         await self._expect(10, 30)         # tune
         w.write(self._frame(1, 0, self._method(
             10, 31, struct.pack(">HIH", 0, 131072, 0))))
@@ -274,7 +280,7 @@ class AmqpSender:
             self._writer.write(self._frame(1, 0, self._method(
                 10, 50, struct.pack(">H", 200) + self._ss("bye")
                 + struct.pack(">HH", 0, 0))))
-            self._writer.close()
+        await _close_writer(self._writer)
 
 
 SENDERS = {"tcp": TcpSender, "mqtt": MqttSender, "coap": CoapSender,

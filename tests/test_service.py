@@ -154,3 +154,55 @@ def test_tenant_consumer_groups_have_single_member(run):
         await rt.stop()
 
     run(main())
+
+
+def test_example_instance_yaml_boots(run):
+    """examples/instance.yaml is living documentation: it must load and
+    boot a full runtime with every configured surface (receivers,
+    scripted decoder, pooled + dedicated scorers, presence, geofence,
+    webhook connector) coming up healthy."""
+
+    async def main():
+        import os
+
+        from sitewhere_tpu.config import load_yaml_config
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples", "instance.yaml")
+        settings, tenants = load_yaml_config(path)
+        assert settings.instance_id == "example"
+        assert [t.tenant_id for t in tenants] == ["factory", "sensors"]
+
+        import dataclasses
+
+        from sitewhere_tpu.cli import _build_runtime
+
+        # ephemeral ports for the test run (the yaml pins real ones)
+        settings = dataclasses.replace(settings, rest_port=0)
+        for t in tenants:
+            for rc in t.sections["event-sources"]["receivers"] \
+                    if "event-sources" in t.sections else []:
+                if "port" in rc:
+                    rc["port"] = 0
+        rt = _build_runtime(settings, [])
+        await rt.start()
+        try:
+            for t in tenants:
+                await rt.add_tenant(t)
+            src = rt.api("event-sources").engine("factory")
+            assert {r.name for r in src.receivers} >= {
+                "default", "gateway", "mqtt", "coap", "json-in"}
+            assert src.decoder_scripts.get("csv") is not None
+            rp = rt.api("rule-processing").engine("factory")
+            assert rp.session is not None          # dedicated scorer
+            assert "geofence" in rp.hooks and "script:audit" in rp.hooks
+            assert rt.api("device-state").state("factory").presence \
+                is not None
+            oc = rt.api("outbound-connectors").engine("factory")
+            assert "ops-hook" in oc.connectors
+            rp2 = rt.api("rule-processing").engine("sensors")
+            assert rp2.pool_slot is not None       # pooled scorer
+        finally:
+            await rt.stop()
+
+    run(main())
