@@ -264,6 +264,9 @@ async def cmd_simulate(args) -> int:
         kw = {"routing_key": args.topic,
               "username": args.username or "guest",
               "password": args.password or "guest"}
+    elif args.protocol == "stomp":
+        kw = {"destination": args.topic, "username": args.username,
+              "password": args.password}
     sender = make_sender(args.protocol, args.host, args.port, **kw)
     await sender.connect()
     sent = 0
@@ -477,7 +480,7 @@ def main(argv=None) -> int:
     p_sim.add_argument("--host", default="127.0.0.1")
     p_sim.add_argument("--port", type=int, default=47800)
     p_sim.add_argument("--protocol", default="tcp",
-                       choices=["tcp", "mqtt", "coap", "websocket", "amqp"],
+                       choices=["tcp", "mqtt", "coap", "websocket", "amqp", "stomp"],
                        help="which hosted endpoint to drive")
     p_sim.add_argument("--devices", type=int, default=1000)
     p_sim.add_argument("--tenant", default="default")

@@ -424,14 +424,14 @@ class CoapEventReceiver(BackgroundTaskComponent):
         await self.listener.stop()
 
 
-class AmqpEventReceiver(BackgroundTaskComponent):
-    """AMQP 0-9-1 ingest endpoint (reference analog: the RabbitMQ
-    inbound receiver): hosts a minimal AMQP server (services/amqp.py) —
-    any standard client (pika, amqplib, gateway SDKs) can connect, open
-    a channel and `basic.publish` SWB1/JSON payloads; confirm-mode
-    publishers get `basic.ack` (at-least-once). The routing key becomes
-    the batch source. `users: {username: password}` enables PLAIN auth
-    (unauthenticated connections are refused with 403)."""
+class _BrokerEventReceiver(BackgroundTaskComponent):
+    """Shared shape for broker-style endpoints whose listener calls
+    `on_message(key, payload, source)` and takes a credential-checking
+    `authenticate(user, secret)` hook (AMQP, STOMP): one copy of the
+    auth/port/process-payload/lifecycle plumbing, subclasses supply the
+    listener class."""
+
+    LISTENER = None   # subclass: callable(on_message, host, port, authenticate)
 
     def __init__(self, name: str, engine: "EventSourcesEngine",
                  decoder: EventDecoder, host: str = "127.0.0.1",
@@ -440,9 +440,7 @@ class AmqpEventReceiver(BackgroundTaskComponent):
         self.engine = engine
         self.decoder = decoder
         self.users = dict(users) if users else None
-        from sitewhere_tpu.services.amqp import AmqpListener
-
-        self.listener = AmqpListener(
+        self.listener = type(self).LISTENER(
             self._on_message, host=host, port=port,
             authenticate=self._authenticate if self.users else None)
 
@@ -453,10 +451,10 @@ class AmqpEventReceiver(BackgroundTaskComponent):
     def port(self) -> int:
         return self.listener.port
 
-    async def _on_message(self, routing_key: str, payload: bytes,
+    async def _on_message(self, key: str, payload: bytes,
                           source: str) -> None:
         await self.engine.process_payload(
-            payload, f"{self.name}:{routing_key}", self.decoder,
+            payload, f"{self.name}:{key}", self.decoder,
             ingest_monotonic=time.monotonic())
 
     async def _do_start(self, monitor) -> None:
@@ -468,6 +466,41 @@ class AmqpEventReceiver(BackgroundTaskComponent):
     async def _do_stop(self, monitor) -> None:
         await super()._do_stop(monitor)
         await self.listener.stop()
+
+
+def _amqp_listener(*a, **k):
+    from sitewhere_tpu.services.amqp import AmqpListener
+
+    return AmqpListener(*a, **k)
+
+
+def _stomp_listener(*a, **k):
+    from sitewhere_tpu.services.stomp import StompListener
+
+    return StompListener(*a, **k)
+
+
+class AmqpEventReceiver(_BrokerEventReceiver):
+    """AMQP 0-9-1 ingest endpoint (reference analog: the RabbitMQ
+    inbound receiver): hosts a minimal AMQP server (services/amqp.py) —
+    any standard client (pika, amqplib, gateway SDKs) can connect, open
+    a channel and `basic.publish` SWB1/JSON payloads; confirm-mode
+    publishers get `basic.ack` (at-least-once). The routing key becomes
+    the batch source. `users: {username: password}` enables PLAIN auth
+    (unauthenticated connections are refused with 403)."""
+
+    LISTENER = staticmethod(_amqp_listener)
+
+
+class StompEventReceiver(_BrokerEventReceiver):
+    """STOMP 1.2 ingest endpoint (reference analog: the ActiveMQ
+    inbound receiver — STOMP is ActiveMQ/Artemis' interoperable wire
+    protocol): clients CONNECT and SEND SWB1/JSON bodies; the
+    destination header becomes the batch source; `receipt` headers are
+    honored (at-least-once handshake). `users: {login: passcode}`
+    enables auth."""
+
+    LISTENER = staticmethod(_stomp_listener)
 
 
 class EventSourcesEngine(TenantEngine):
@@ -580,6 +613,11 @@ class EventSourcesEngine(TenantEngine):
                                   host=cfg.get("host", "127.0.0.1"),
                                   port=cfg.get("port", 0),
                                   users=cfg.get("users"))
+        elif kind == "stomp":
+            r = StompEventReceiver(name, self, decoder,
+                                   host=cfg.get("host", "127.0.0.1"),
+                                   port=cfg.get("port", 0),
+                                   users=cfg.get("users"))
         else:
             raise ValueError(f"unknown receiver kind {kind!r}")
         self.receivers.append(r)

@@ -283,8 +283,51 @@ class AmqpSender:
         await _close_writer(self._writer)
 
 
+class StompSender:
+    """STOMP 1.2 publisher: CONNECT (optional login/passcode), SEND
+    with content-length binary bodies."""
+
+    def __init__(self, host: str, port: int, destination: str = "telemetry",
+                 username: Optional[str] = None,
+                 password: Optional[str] = None):
+        self.host, self.port = host, port
+        self.destination = destination
+        self.username, self.password = username, password
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        creds = ""
+        if self.username is not None:
+            creds = (f"login:{self.username}\n"
+                     f"passcode:{self.password or ''}\n")
+        self._writer.write(
+            f"CONNECT\naccept-version:1.2\n{creds}\n".encode() + b"\x00")
+        await self._writer.drain()
+        reply = await asyncio.wait_for(
+            self._reader.readuntil(b"\x00"), 10.0)
+        if not reply.startswith(b"CONNECTED"):
+            raise ConnectionError(
+                f"STOMP refused: {reply.split(b'\x0a', 1)[0]!r}")
+
+    async def send(self, payload: bytes) -> None:
+        self._writer.write(
+            (f"SEND\ndestination:{self.destination}\n"
+             f"content-length:{len(payload)}\n\n").encode()
+            + payload + b"\x00")
+        await self._writer.drain()
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.write(b"DISCONNECT\n\n\x00")
+        await _close_writer(self._writer)
+
+
 SENDERS = {"tcp": TcpSender, "mqtt": MqttSender, "coap": CoapSender,
-           "websocket": WebSocketSender, "amqp": AmqpSender}
+           "websocket": WebSocketSender, "amqp": AmqpSender,
+           "stomp": StompSender}
 
 
 def make_sender(protocol: str, host: str, port: int, **kw):

@@ -1008,3 +1008,152 @@ def test_amqp_malformed_fuzz_endpoint_survives(run):
             await listener.stop()
 
     run(main())
+
+
+# -- STOMP 1.2 ---------------------------------------------------------------
+
+
+async def _stomp_read_frame(reader):
+    data = await asyncio.wait_for(reader.readuntil(b"\x00"), 5.0)
+    head, _, body = data[:-1].partition(b"\n\n")
+    lines = head.decode().replace("\r\n", "\n").split("\n")
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        if k and k not in headers:
+            headers[k] = v
+    return lines[0], headers, body
+
+
+def test_stomp_ingest_binary_receipts_and_auth(run):
+    """e2e: SWB1 telemetry SENT over STOMP (content-length binary body,
+    receipt handshake) is decoded, persisted, and scored; wrong
+    credentials get an ERROR frame; a NUL-free text body also works."""
+
+    async def main():
+        sections = {
+            "event-sources": {"receivers": [
+                {"kind": "queue", "decoder": "swb1", "name": "default"},
+                {"kind": "stomp", "decoder": "swb1", "name": "stomp",
+                 "users": {"gw": "pw"}}]},
+            "rule-processing": {"model": "zscore",
+                                "model_config": {"window": 16},
+                                "threshold": 5.0, "batch_window_ms": 1.0},
+        }
+        async with running_pipeline(num_devices=20,
+                                    sections=sections) as rt:
+            sim = DeviceSimulator(SimConfig(num_devices=20, seed=9),
+                                  tenant_id="acme")
+            receiver = rt.api("event-sources").engine("acme") \
+                .receiver("default")
+            for k in range(20):
+                await receiver.submit(sim.payload(t=60.0 * k)[0])
+            em = rt.api("event-management").management("acme")
+            await wait_until(lambda: em.telemetry.total_events == 400)
+
+            stomp = rt.api("event-sources").engine("acme").receiver("stomp")
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", stomp.port)
+            writer.write(b"CONNECT\naccept-version:1.2\nlogin:gw\n"
+                         b"passcode:pw\n\n\x00")
+            cmd, headers, _ = await _stomp_read_frame(reader)
+            assert cmd == "CONNECTED" and headers["version"] == "1.2"
+
+            sim.cfg = SimConfig(num_devices=20, seed=9, anomaly_rate=1.0,
+                                anomaly_magnitude=20.0)
+            payload, truth = sim.payload(t=21 * 60.0)
+            assert truth.all()
+            writer.write(b"SEND\ndestination:/queue/telemetry\n"
+                         + f"content-length:{len(payload)}\n".encode()
+                         + b"receipt:r1\n\n" + payload + b"\x00")
+            cmd, headers, _ = await _stomp_read_frame(reader)
+            assert cmd == "RECEIPT" and headers["receipt-id"] == "r1"
+            await wait_until(
+                lambda: em.telemetry.total_events == 420, timeout=10.0)
+            await wait_until(
+                lambda: any(a.event_date == 21 * 60.0
+                            for a in em.list_alerts()), timeout=15.0)
+
+            # clean disconnect with receipt
+            writer.write(b"DISCONNECT\nreceipt:r2\n\n\x00")
+            cmd, headers, _ = await _stomp_read_frame(reader)
+            assert cmd == "RECEIPT" and headers["receipt-id"] == "r2"
+            writer.close()
+
+            # wrong passcode → ERROR frame
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", stomp.port)
+            writer.write(b"CONNECT\nlogin:gw\npasscode:nope\n\n\x00")
+            cmd, headers, _ = await _stomp_read_frame(reader)
+            assert cmd == "ERROR"
+            writer.close()
+
+            # CRLF-framed client (spec allows EOL = \r\n) must work,
+            # and a receipt id with an escaped newline must round-trip
+            # escaped in the RECEIPT (no header-line injection)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", stomp.port)
+            writer.write(b"CONNECT\r\naccept-version:1.2\r\n"
+                         b"login:gw\r\npasscode:pw\r\n\r\n\x00")
+            cmd, headers, _ = await _stomp_read_frame(reader)
+            assert cmd == "CONNECTED"
+            writer.write(b"SEND\r\ndestination:d\r\n"
+                         b"receipt:a\\nb\r\n\r\ncrlf-body\x00")
+            raw = await asyncio.wait_for(reader.readuntil(b"\x00"), 5.0)
+            assert b"receipt-id:a\\nb\n" in raw   # escaped, not injected
+            await wait_until(
+                lambda: em.telemetry.total_events == 420, timeout=10.0)
+            writer.close()
+
+    run(main())
+
+
+def test_stomp_fuzz_and_unsupported_frames(run):
+    """Garbage and truncated streams kill at most their own connection;
+    SUBSCRIBE gets a receipt (strict clients don't stall); unsupported
+    frames get an ERROR frame."""
+
+    async def main():
+        from sitewhere_tpu.services.stomp import StompListener
+
+        got = []
+
+        async def on_message(dest, body, source):
+            got.append((dest, body))
+
+        listener = StompListener(on_message)
+        await listener.start()
+        try:
+            rng = np.random.default_rng(11)
+            valid = (b"CONNECT\n\n\x00"
+                     b"SEND\ndestination:d\n\nhello\x00")
+            for i in range(40):
+                r, w = await asyncio.open_connection("127.0.0.1",
+                                                     listener.port)
+                if i % 2:
+                    n = int(rng.integers(1, 96))
+                    w.write(bytes(rng.integers(0, 256, n, dtype=np.uint8)))
+                else:
+                    w.write(valid[:int(rng.integers(1, len(valid)))])
+                await w.drain()
+                w.close()
+            # endpoint alive; subscribe ack'd; bad frame → ERROR
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", listener.port)
+            writer.write(b"STOMP\naccept-version:1.2\n\n\x00")
+            cmd, _, _ = await _stomp_read_frame(reader)
+            assert cmd == "CONNECTED"
+            writer.write(b"SUBSCRIBE\nid:0\ndestination:d\nreceipt:s\n\n\x00")
+            cmd, headers, _ = await _stomp_read_frame(reader)
+            assert cmd == "RECEIPT" and headers["receipt-id"] == "s"
+            writer.write(b"SEND\ndestination:d\n\npayload-text\x00")
+            await wait_until(lambda: got == [("d", b"payload-text")],
+                             timeout=5.0)
+            writer.write(b"WAT\n\n\x00")
+            cmd, _, _ = await _stomp_read_frame(reader)
+            assert cmd == "ERROR"
+            writer.close()
+        finally:
+            await listener.stop()
+
+    run(main())

@@ -744,14 +744,15 @@ def test_simulator_clients_drive_every_protocol(run):
             {"kind": "mqtt", "decoder": "swb1", "name": "mqtt"},
             {"kind": "coap", "decoder": "swb1", "name": "coap"},
             {"kind": "websocket", "decoder": "swb1", "name": "websocket"},
-            {"kind": "amqp", "decoder": "swb1", "name": "amqp"}]}}
+            {"kind": "amqp", "decoder": "swb1", "name": "amqp"},
+            {"kind": "stomp", "decoder": "swb1", "name": "stomp"}]}}
         async with full_instance(sections, num_devices=10) as rt:
             em = rt.api("event-management").management("acme")
             sources = rt.api("event-sources").engine("acme")
             sim = DeviceSimulator(SimConfig(num_devices=10), tenant_id="acme")
             expected = 0
             for k, proto in enumerate(
-                    ("tcp", "mqtt", "coap", "websocket", "amqp")):
+                    ("tcp", "mqtt", "coap", "websocket", "amqp", "stomp")):
                 port = sources.receiver(proto).port
                 sender = make_sender(proto, "127.0.0.1", port)
                 await sender.connect()
