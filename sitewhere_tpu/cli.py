@@ -83,6 +83,14 @@ _REMOTE_CONSUMERS = {"device-management": {"inbound-processing"}}
 
 def _validate_split(services, remotes):
     if services is None:
+        if remotes:
+            # no --services = EVERY service hosted locally, so any
+            # --remote collides with its local twin (api() resolution
+            # would be ambiguous at runtime); fail at startup instead
+            raise SystemExit(
+                f"swx run: --remote {sorted(remotes)} conflicts with "
+                f"hosting all services locally; use --services to pick "
+                f"this process's subset")
         return
     for name in services:
         need = _COLOCATE.get(name, set())

@@ -206,3 +206,40 @@ def test_example_instance_yaml_boots(run):
             await rt.stop()
 
     run(main())
+
+
+def test_cli_split_validation():
+    """`swx run --services/--remote` misconfigurations fail loudly at
+    startup (colocation constraints, unsupported remotes, unused
+    remotes) rather than misbehaving at runtime."""
+    import pytest
+
+    from sitewhere_tpu.cli import _validate_split
+
+    # rule-processing needs event-management + device-state colocated
+    with pytest.raises(SystemExit, match="colocated"):
+        _validate_split({"rule-processing"}, None)
+    # a valid scorer-process split passes
+    _validate_split({"device-management", "inbound-processing",
+                     "event-management", "device-state",
+                     "rule-processing"}, None)
+    # a service can't be both local and remote
+    with pytest.raises(SystemExit, match="both local"):
+        _validate_split({"device-management", "inbound-processing"},
+                        {"device-management": ("h", 1)})
+    # only wire-aware identifiers may be remote
+    with pytest.raises(SystemExit, match="not supported"):
+        _validate_split({"inbound-processing"},
+                        {"event-sources": ("h", 1)})
+    # a remote nobody consumes is a config error, not silence
+    with pytest.raises(SystemExit, match="unused"):
+        _validate_split({"event-sources"},
+                        {"device-management": ("h", 1)})
+    # the supported remote with its consumer passes
+    _validate_split({"inbound-processing"},
+                    {"device-management": ("h", 1)})
+    # no --services means ALL services local: any --remote collides
+    with pytest.raises(SystemExit, match="conflicts"):
+        _validate_split(None, {"device-management": ("h", 1)})
+    _validate_split(None, None)
+    _validate_split(None, {})
