@@ -844,6 +844,9 @@ async def run_bench(args) -> dict:
     # (its incomplete drain shows in the artifact)
     clean = [t for t in trials if t["drain_complete"]] or trials
     best = max(clean, key=lambda t: t["rate"])
+    import statistics
+
+    rate_median = statistics.median(t["rate"] for t in clean)
     rate = best["rate"]
     scored = best["events_scored"]
     elapsed = best["seconds"]
@@ -916,7 +919,13 @@ async def run_bench(args) -> dict:
         "metric": "pipeline_scored_events_per_sec",
         "value": round(rate, 1),
         "unit": "events/s",
+        # `value` is best-of-N clean-drain trials (3× tunnel variance —
+        # see BASELINE.md); `value_median` is the honest center, so
+        # cross-round comparisons never mistake the optimistic tail
+        # for the typical rate
+        "value_median": round(rate_median, 1),
         "vs_baseline": round(rate / 1_000_000, 4),
+        "vs_baseline_median": round(rate_median / 1_000_000, 4),
         "p99_ms": round(p99 * 1e3, 3),
         "p50_ms": round(p50 * 1e3, 3),
         "p99_breakdown": breakdown,
@@ -925,6 +934,11 @@ async def run_bench(args) -> dict:
         "seconds": round(elapsed, 2),
         "saturation_trials": trials,
         "model": args.model,
+        # Pallas fused-scorer evidence (dedicated-ring path only):
+        # "compiled" = kernel engaged on this backend, "compile_failed" =
+        # probe fell back to the scan, null = never attempted
+        "pallas": getattr(getattr(session, "ring", None),
+                          "fused_status", None),
         "tenants": len(tenant_ids),
         "model_flops_per_event": flops_ev,
         "model_tflops": round(model_flops_s / 1e12, 3),
@@ -1022,6 +1036,13 @@ def main() -> None:
     if not args.inner:
         argv = [a for a in sys.argv[1:] if a != "--inner"]
         sys.exit(run_supervised(args, argv))
+    # make the ring's "kernel path engaged" INFO line visible in bench
+    # stderr (the artifact's `pallas` field is the authoritative record;
+    # this is the live trail for watcher logs)
+    import logging
+
+    logging.basicConfig()
+    logging.getLogger("sitewhere_tpu.scoring.ring").setLevel(logging.INFO)
     try:
         result = (run_train_bench(args) if args.train
                   else run_gnn_bench(args) if args.gnn

@@ -119,7 +119,19 @@ def pallas_ok(batch: int, layers: int, cdt=jnp.bfloat16) -> bool:
             # "", "0", "false", "no", "off" all keep the kernel enabled
             and os.environ.get("SWX_DISABLE_PALLAS", "").lower()
             not in ("1", "true", "yes", "on")
-            and jax.default_backend() == "tpu")
+            and _backend_is_tpu())
+
+
+def _backend_is_tpu() -> bool:
+    """True when the default backend's DEVICES are TPU. Checked via
+    `devices()[0].platform` (== "tpu" on this rig) rather than
+    `jax.default_backend()`, which returns the PLUGIN registry name —
+    "axon" for the tunneled-TPU plugin here — and would silently keep
+    the kernel disabled on the very hardware it targets."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 - unreachable backend → no kernel
+        return False
 
 
 def lstm_window_final(params_layer: dict, xn: jax.Array, cdt,

@@ -21,6 +21,7 @@ warmup or after a dispatch fault.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional
 
 import jax
@@ -28,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from sitewhere_tpu.utils import grow_pow2
+
+logger = logging.getLogger(__name__)
 
 
 class DeviceRing:
@@ -45,6 +48,10 @@ class DeviceRing:
         # fused-scorer viability is per backend, not per shape: one
         # failed Pallas compile disables it for every bucket/growth
         self._fused_broken = False
+        # evidence trail for the bench artifact: None = fused path never
+        # attempted (model has none / predicate declined), else
+        # "compiled" / "compile_failed"
+        self.fused_status: Optional[str] = None
         self.faulted = False  # True after a failed dispatch donated state away
         self._alloc(self.capacity)
 
@@ -154,18 +161,25 @@ class DeviceRing:
                 # success the Compiled object is kept (no re-compile at
                 # dispatch); on failure the verdict is remembered so
                 # other buckets skip the doomed attempt.
+                compiled_ok = False
                 try:
                     fn = fn.lower(params, self.values, self.count,
                                   self.cursor, pdev, pv).compile()
+                    compiled_ok = True
                 except Exception:  # noqa: BLE001 - any compile failure
-                    import logging
-
-                    logging.getLogger(__name__).warning(
+                    logger.warning(
                         "fused scorer failed to compile; using the "
                         "reference scan path", exc_info=True)
                     self._fused_broken = True
+                    self.fused_status = "compile_failed"
                     fn = self._build_update_score(
                         model, self.capacity, bucket, prefer_fused=False)
+                if compiled_ok:
+                    self.fused_status = "compiled"
+                    logger.info(
+                        "fused Pallas scorer compiled for bucket %d "
+                        "(capacity %d) — kernel path engaged",
+                        bucket, self.capacity)
             self._update_score_fns[key] = fn
         try:
             self.values, self.count, self.cursor, scores = fn(

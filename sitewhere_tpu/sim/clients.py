@@ -309,8 +309,10 @@ class StompSender:
         reply = await asyncio.wait_for(
             self._reader.readuntil(b"\x00"), 10.0)
         if not reply.startswith(b"CONNECTED"):
-            raise ConnectionError(
-                f"STOMP refused: {reply.split(b'\x0a', 1)[0]!r}")
+            # split hoisted out of the f-string: \x0a inside an f-string
+            # expression is a SyntaxError before Python 3.12
+            first_line = reply.split(b"\x0a", 1)[0]
+            raise ConnectionError(f"STOMP refused: {first_line!r}")
 
     async def send(self, payload: bytes) -> None:
         self._writer.write(

@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-5 serialized tunnel watcher -> bench suite.
+# ONE JAX process at a time, ever (the tunnel serializes; concurrent
+# probes zeroed round 3 and contended round 4). Probes in a killable
+# subprocess; on first healthy probe runs the full BASELINE bench
+# suite in order, logging stdout/stderr per run, then touches DONE.
+set -u
+cd /root/repo
+OUT=tpu_r05
+mkdir -p "$OUT"
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
+
+log "watcher started pid=$$"
+
+# ---- phase 1: probe until healthy ----
+while true; do
+  if timeout 150 python bench.py --probe-only > "$OUT/probe.json" 2> "$OUT/probe.err"; then
+    if grep -q '"platform": "tpu"' "$OUT/probe.json"; then
+      log "HEALTHY: $(cat "$OUT/probe.json")"
+      break
+    fi
+    log "probe answered non-tpu: $(cat "$OUT/probe.json")"
+  else
+    log "probe down rc=$? (timeout or error)"
+  fi
+  sleep 240
+done
+
+# ---- phase 2: serial bench suite (each run re-probes via its own
+# supervisor; probe-horizon kept short so a mid-suite outage skips
+# ahead instead of burning 10 min per leg) ----
+run() {
+  name=$1; shift
+  log "RUN $name: python bench.py $*"
+  timeout 2700 python bench.py --probe-horizon 120 "$@" \
+    > "$OUT/$name.json" 2> "$OUT/$name.err"
+  rc=$?
+  log "DONE $name rc=$rc result=$(tail -c 300 "$OUT/$name.json" | tr '\n' ' ')"
+  sleep 5
+}
+
+run default                       # driver-shaped: plain defaults
+run headline --seconds 5 --latency-seconds 3 --model lstm-stream --paced-fraction 0.4 --devices 16384
+run lstm_pallas --model lstm --seconds 5 --latency-seconds 3 --devices 16384
+export SWX_DISABLE_PALLAS=1
+run lstm_scan --model lstm --seconds 5 --latency-seconds 3 --devices 16384
+unset SWX_DISABLE_PALLAS
+run tft --model tft --devices 1024 --seconds 3 --latency-seconds 2
+run pooled --pooled 8 --devices 8192 --seconds 3 --latency-seconds 2
+run gnn --gnn
+run split --split --devices 4096 --seconds 3 --latency-seconds 2
+log "RUN train: python bench.py --train"
+timeout 3900 python bench.py --probe-horizon 120 --train \
+  > "$OUT/train.json" 2> "$OUT/train.err"
+log "DONE train rc=$? result=$(tail -c 300 "$OUT/train.json" | tr '\n' ' ')"
+
+touch "$OUT/DONE"
+log "suite complete"
