@@ -710,7 +710,8 @@ async def run_bench(args) -> dict:
     platform, device_kind, n_chips = probe_backend()
 
     rt = ServiceRuntime(InstanceSettings(
-        instance_id="bench", engine_ready_timeout_s=args.ready_timeout))
+        instance_id="bench", engine_ready_timeout_s=args.ready_timeout,
+        data_dir=args.durable))
     for cls in (DeviceManagementService, EventSourcesService,
                 InboundProcessingService, EventManagementService,
                 DeviceStateService, RuleProcessingService):
@@ -913,6 +914,15 @@ async def run_bench(args) -> dict:
     peak = next((v for k_, v in PEAK_BF16_FLOPS if k_ in kind_l), None)
     mfu = (model_flops_s / (peak * n_chips)) if peak else None
 
+    # spill fidelity: a --durable number is only comparable to the
+    # RAM-only number if nothing was dropped; record the counters
+    spill = None
+    if args.durable:
+        logs = [rt.api("event-management").management(t).durable
+                for t in tenant_ids]
+        spill = {"written": sum(d.written for d in logs if d),
+                 "dropped": sum(d.dropped for d in logs if d)}
+
     await rt.stop()
 
     return {
@@ -944,6 +954,8 @@ async def run_bench(args) -> dict:
         "model_tflops": round(model_flops_s / 1e12, 3),
         "mfu": round(mfu, 5) if mfu is not None else None,
         "fleet_devices": args.devices,
+        "durable": bool(args.durable),
+        "durable_spill": spill,
         "chips": n_chips,
         "device_kind": device_kind,
         "platform": platform,
@@ -1014,6 +1026,11 @@ def main() -> None:
                         help=argparse.SUPPRESS)  # internal: subprocess probe
     parser.add_argument("--inner", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run bench bodies
+    parser.add_argument("--durable", default=None, metavar="DIR",
+                        help="enable the durable event store (segment "
+                             "spill + registry snapshots) rooted at DIR; "
+                             "measures the spill tax vs the RAM-only "
+                             "default")
     parser.add_argument("--force-cpu", action="store_true",
                         help="run on the CPU backend (the supervisor uses "
                              "this when the accelerator is unreachable)")

@@ -41,6 +41,14 @@ class InstanceSettings:
     # engine spin-up bound: first TPU compiles over a tunneled chip can
     # take minutes — the old 60 s default killed whole bench runs
     engine_ready_timeout_s: float = 300.0
+    # durability root (persistence/durable.py): when set, event history
+    # spills to <data_dir>/tenants/<tenant>/events/ and the device
+    # registry snapshots to <data_dir>/tenants/<tenant>/registry.snap;
+    # both are replayed/restored on boot. None = RAM-only (fastest).
+    data_dir: Optional[str] = None
+    durable_fsync_interval_s: float = 0.2
+    durable_segment_bytes: int = 4 << 20
+    durable_max_segments: int = 64
     # log level
     log_level: str = "INFO"
 
@@ -50,6 +58,7 @@ class InstanceSettings:
             "instance_id": os.environ.get("SWX_INSTANCE_ID"),
             "rest_port": os.environ.get("SWX_REST_PORT"),
             "jwt_secret": os.environ.get("SWX_JWT_SECRET"),
+            "data_dir": os.environ.get("SWX_DATA_DIR"),
         }
         kwargs: dict[str, Any] = {k: v for k, v in env_map.items() if v is not None}
         if "rest_port" in kwargs:

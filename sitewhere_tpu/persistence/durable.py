@@ -1,0 +1,301 @@
+"""Durable event persistence: segmented spill log + entity snapshots.
+
+The reference's event-management component is backed by a *durable*
+event store (Mongo/InfluxDB/Cassandra behind `IDeviceEventManagement`,
+[SURVEY.md §2.2]), and its recovery story treats that store as the
+source of truth when stream retention has expired ([SURVEY.md §5.4]).
+This module is the TPU-first equivalent:
+
+- The **hot store stays the columnar RAM ring** (vectorized append,
+  model-shaped reads — persistence/telemetry.py). Durability is a
+  sequential appendix, not a different data path.
+- A **segmented record log** spills every persisted batch to disk:
+  hot batches in their existing SWB1 wire form (`batch.encode()` —
+  domain/batch.py), cold events via the restricted codec
+  (kernel/codec.py). One background thread owns all disk IO; the
+  ingest hot path only enqueues object references.
+- **Replay on boot** re-appends the log into the columnar store before
+  services come up, so scoring warmup (`ScoringSession.warmup` seeds
+  the device ring from the host store) resumes from recovered history
+  with no extra machinery.
+- **Entity snapshots** (device registry etc.) are whole-store codec
+  blobs written atomically (tmp + fsync + rename) by a debounced
+  background task.
+
+Offsets note: with the in-proc bus, topics die with the process — the
+durable log IS the resume story, exactly like the reference recovering
+from its event store when Kafka retention has lapsed. With the Kafka
+adapter (kernel/kafka_bus.py), group offsets live server-side and this
+log is belt-and-braces local history.
+
+Crash window: the writer fsyncs every `fsync_interval_s` (default
+0.2 s) — a hard kill can lose at most that much of the newest history
+(same contract as Cassandra's default periodic commitlog sync). The
+torn tail is detected by per-record CRC and truncated on replay.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import struct
+import threading
+import zlib
+from typing import Callable, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+# record framing: len u32 | crc32(payload) u32 | rtype u8
+_REC = struct.Struct("<IIB")
+RT_MEASUREMENTS = 1
+RT_LOCATIONS = 2
+RT_COLD = 3
+
+_SEG_FMT = "events-{:08d}.seg"
+
+
+class SegmentLog:
+    """Append-only segmented record log with CRC framing.
+
+    Single-writer (the owning thread), multi-segment, bounded: when
+    `max_segments` is exceeded the oldest segment is deleted — the RAM
+    ring only holds `history` points per device, so unbounded disk
+    history buys nothing the training snapshot can use.
+    """
+
+    def __init__(self, directory: str, segment_bytes: int = 4 << 20,
+                 max_segments: int = 64,
+                 fsync_interval_s: float = 0.2):
+        self.dir = directory
+        self.segment_bytes = int(segment_bytes)
+        self.max_segments = int(max_segments)
+        self.fsync_interval_s = float(fsync_interval_s)
+        os.makedirs(directory, exist_ok=True)
+        existing = self._segments()
+        self._seq = (existing[-1][0] + 1) if existing else 1
+        self._file = None
+        self._file_bytes = 0
+        self._dirty = False
+        self._last_fsync = 0.0
+
+    # -- segment bookkeeping ----------------------------------------------
+
+    def _segments(self) -> list[tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("events-") and name.endswith(".seg"):
+                try:
+                    out.append((int(name[7:-4]), os.path.join(self.dir, name)))
+                except ValueError:
+                    continue
+        out.sort()
+        return out
+
+    def _open_active(self) -> None:
+        path = os.path.join(self.dir, _SEG_FMT.format(self._seq))
+        self._file = open(path, "ab")
+        self._file_bytes = self._file.tell()
+
+    def _rotate(self) -> None:
+        self._sync(force=True)
+        self._file.close()
+        self._seg_prune()
+        self._seq += 1
+        self._open_active()
+
+    def _seg_prune(self) -> None:
+        segs = self._segments()
+        excess = len(segs) - self.max_segments
+        for _, path in segs[:max(excess, 0)]:
+            try:
+                os.remove(path)
+            except OSError:
+                logger.warning("could not prune segment %s", path,
+                               exc_info=True)
+
+    # -- write path (owning thread only) -----------------------------------
+
+    def append(self, rtype: int, payload: bytes) -> None:
+        if self._file is None:
+            self._open_active()
+        hdr = _REC.pack(len(payload), zlib.crc32(payload), rtype)
+        self._file.write(hdr)
+        self._file.write(payload)
+        self._file_bytes += len(hdr) + len(payload)
+        self._dirty = True
+        if self._file_bytes >= self.segment_bytes:
+            self._rotate()
+
+    def _sync(self, force: bool = False) -> None:
+        import time
+
+        if self._file is None or not self._dirty:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_fsync < self.fsync_interval_s:
+            self._file.flush()
+            return
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._dirty = False
+        self._last_fsync = now
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._sync(force=True)
+            self._file.close()
+            self._file = None
+
+    # -- replay ------------------------------------------------------------
+
+    def replay(self) -> Iterator[tuple[int, memoryview]]:
+        """Yield (rtype, payload) across all segments in order. A torn or
+        corrupt record ends replay for that segment (CRC guard); the
+        active segment's well-formed prefix is always recovered."""
+        for seq, path in self._segments():
+            with open(path, "rb") as f:
+                data = f.read()
+            mv = memoryview(data)
+            off = 0
+            while off + _REC.size <= len(mv):
+                ln, crc, rtype = _REC.unpack_from(mv, off)
+                start = off + _REC.size
+                end = start + ln
+                if end > len(mv):
+                    logger.warning("torn record at %s+%d (want %d bytes, "
+                                   "have %d) — truncating replay of this "
+                                   "segment", path, off, ln, len(mv) - start)
+                    break
+                payload = mv[start:end]
+                if zlib.crc32(payload) != crc:
+                    logger.warning("CRC mismatch at %s+%d — truncating "
+                                   "replay of this segment", path, off)
+                    break
+                yield rtype, payload
+                off = end
+
+
+class DurableEventLog:
+    """Thread-offloaded spill writer over a SegmentLog.
+
+    `submit()` is called from the service event loop and only enqueues;
+    the writer thread encodes (SWB1 / codec) and appends. The queue is
+    bounded: if the disk can't keep up, the newest batch is dropped and
+    counted (`dropped`) rather than stalling ingest — durability is a
+    best-effort appendix on this rig, never backpressure on the hot
+    path (the artifactual <10 % bench budget; see BASELINE.md)."""
+
+    def __init__(self, directory: str, segment_bytes: int = 4 << 20,
+                 max_segments: int = 64, fsync_interval_s: float = 0.2,
+                 queue_max: int = 4096):
+        self.log = SegmentLog(directory, segment_bytes=segment_bytes,
+                              max_segments=max_segments,
+                              fsync_interval_s=fsync_interval_s)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_max)
+        self.dropped = 0
+        self.written = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"swx-spill:{os.path.basename(directory)}",
+            daemon=True)
+        self._closed = threading.Event()
+        self._thread.start()
+
+    # -- producer side (event loop) ----------------------------------------
+
+    def submit(self, rtype: int, obj) -> None:
+        try:
+            self._q.put_nowait((rtype, obj))
+        except queue.Full:
+            self.dropped += 1
+            if self.dropped in (1, 100, 10_000):
+                logger.warning("spill queue full — dropped %d record(s); "
+                               "disk is not keeping up with ingest",
+                               self.dropped)
+
+    # -- writer thread ------------------------------------------------------
+
+    def _encode(self, rtype: int, obj) -> bytes:
+        if rtype in (RT_MEASUREMENTS, RT_LOCATIONS):
+            return obj.encode()
+        from sitewhere_tpu.kernel import codec
+
+        return codec.encode(obj)
+
+    def _run(self) -> None:
+        while not self._closed.is_set() or not self._q.empty():
+            try:
+                rtype, obj = self._q.get(
+                    timeout=self.log.fsync_interval_s)
+            except queue.Empty:
+                self.log._sync()
+                continue
+            try:
+                self.log.append(rtype, self._encode(rtype, obj))
+                self.written += 1
+            except Exception:  # noqa: BLE001 - spill must never kill ingest
+                logger.warning("spill write failed; record lost",
+                               exc_info=True)
+            # unconditional: _sync rate-limits its own fsync, but the
+            # flush must happen per record — otherwise sustained ingest
+            # (queue never empty) leaves data in the userspace buffer
+            # until segment rotation and a kill -9 loses far more than
+            # the documented fsync_interval_s window
+            self.log._sync()
+        self.log.close()
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._closed.set()
+        self._thread.join(timeout)
+
+    def replay(self, handler: Callable[[int, memoryview], None]) -> int:
+        """Feed every recovered record to `handler`; returns count."""
+        n = 0
+        for rtype, payload in self.log.replay():
+            try:
+                handler(rtype, payload)
+                n += 1
+            except Exception:  # noqa: BLE001 - one bad record ≠ no recovery
+                logger.warning("replay handler failed for a record; "
+                               "skipping", exc_info=True)
+        return n
+
+
+# -- entity snapshots -------------------------------------------------------
+
+_SNAP = struct.Struct("<II")  # len u32 | crc32 u32
+
+
+def save_snapshot(path: str, obj) -> None:
+    """Atomic whole-object snapshot: codec blob + CRC, tmp+fsync+rename."""
+    from sitewhere_tpu.kernel import codec
+
+    payload = codec.encode(obj)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_SNAP.pack(len(payload), zlib.crc32(payload)))
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str):
+    """Load a snapshot or return None (missing/torn/corrupt — a bad
+    snapshot is treated as absent, never as a crash)."""
+    from sitewhere_tpu.kernel import codec
+
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return None
+    if len(data) < _SNAP.size:
+        logger.warning("snapshot %s truncated; ignoring", path)
+        return None
+    ln, crc = _SNAP.unpack_from(data, 0)
+    payload = data[_SNAP.size:_SNAP.size + ln]
+    if len(payload) != ln or zlib.crc32(payload) != crc:
+        logger.warning("snapshot %s failed CRC; ignoring", path)
+        return None
+    return codec.decode(payload)

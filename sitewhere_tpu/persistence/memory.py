@@ -50,6 +50,11 @@ from sitewhere_tpu.domain.model import (
     User,
     Zone,
 )
+from sitewhere_tpu.persistence.durable import (
+    RT_COLD,
+    RT_LOCATIONS,
+    RT_MEASUREMENTS,
+)
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
 
 
@@ -61,14 +66,17 @@ def _page(items: list, page: int, page_size: int) -> list:
 class _EntityTable:
     """id + token indexed table for one entity type."""
 
-    def __init__(self) -> None:
+    def __init__(self, on_mutate=None) -> None:
         self.by_id: dict[str, object] = {}
         self.by_token: dict[str, str] = {}
+        self._on_mutate = on_mutate
 
     def put(self, entity) -> object:
         self.by_id[entity.id] = entity
         if entity.token:
             self.by_token[entity.token] = entity.id
+        if self._on_mutate is not None:
+            self._on_mutate()
         return entity
 
     def get(self, id: str):
@@ -82,6 +90,8 @@ class _EntityTable:
         entity = self.by_id.pop(id, None)
         if entity is not None and getattr(entity, "token", ""):
             self.by_token.pop(entity.token, None)
+        if entity is not None and self._on_mutate is not None:
+            self._on_mutate()
         return entity
 
     def values(self) -> list:
@@ -96,21 +106,68 @@ class InMemoryDeviceManagement:
     scored batches are materialized into alerts.
     """
 
+    # entity tables snapshotted/restored as a unit (order is cosmetic;
+    # restore rebuilds all derived indexes from entity contents)
+    _TABLES = ("device_types", "commands", "statuses", "devices",
+               "assignments", "groups", "customers", "areas", "zones")
+
     def __init__(self) -> None:
-        self.device_types = _EntityTable()
-        self.commands = _EntityTable()
-        self.statuses = _EntityTable()
-        self.devices = _EntityTable()
-        self.assignments = _EntityTable()
-        self.groups = _EntityTable()
+        # mutation epoch: bumped on every entity write/delete — the
+        # snapshotter's "anything changed since last save?" signal
+        self.mutations = 0
+        bump = self._bump_mutations
+        self.device_types = _EntityTable(bump)
+        self.commands = _EntityTable(bump)
+        self.statuses = _EntityTable(bump)
+        self.devices = _EntityTable(bump)
+        self.assignments = _EntityTable(bump)
+        self.groups = _EntityTable(bump)
         self.group_elements: dict[str, list[DeviceGroupElement]] = {}
-        self.customers = _EntityTable()
-        self.areas = _EntityTable()
-        self.zones = _EntityTable()
+        self.customers = _EntityTable(bump)
+        self.areas = _EntityTable(bump)
+        self.zones = _EntityTable(bump)
         self._next_index = 0
         self._token_to_index: dict[str, int] = {}
         self._index_to_device_id: dict[int, str] = {}
         self._active_assignment_by_device: dict[str, list[str]] = {}
+
+    def _bump_mutations(self) -> None:
+        self.mutations += 1
+
+    # -- durability (persistence/durable.py snapshots) ---------------------
+
+    def to_snapshot(self) -> dict:
+        """Whole-store state as codec-serializable primitives + entities."""
+        return {
+            "tables": {name: list(getattr(self, name).by_id.values())
+                       for name in self._TABLES},
+            "group_elements": {gid: list(els) for gid, els
+                               in self.group_elements.items()},
+            "next_index": self._next_index,
+        }
+
+    def restore_snapshot(self, snap: dict) -> None:
+        """Rebuild every table and derived index from `to_snapshot()`
+        output. Active-assignment lists are derived from assignment
+        status; device index maps from the entities themselves."""
+        for name in self._TABLES:
+            table = getattr(self, name)
+            for entity in snap["tables"].get(name, []):
+                table.by_id[entity.id] = entity
+                if getattr(entity, "token", ""):
+                    table.by_token[entity.token] = entity.id
+        self.group_elements = {gid: list(els) for gid, els
+                               in snap.get("group_elements", {}).items()}
+        self._next_index = int(snap.get("next_index", 0))
+        for d in self.devices.by_id.values():
+            if d.token:
+                self._token_to_index[d.token] = d.index
+            self._index_to_device_id[d.index] = d.id
+            self._next_index = max(self._next_index, d.index + 1)
+        for a in self.assignments.by_id.values():
+            if a.status == DeviceAssignmentStatus.ACTIVE:
+                self._active_assignment_by_device.setdefault(
+                    a.device_id, []).append(a.id)
 
     # -- device types ------------------------------------------------------
 
@@ -285,6 +342,7 @@ class InMemoryDeviceManagement:
         stored = self.group_elements.setdefault(group_id, [])
         for el in elements:
             stored.append(dataclasses.replace(el, group_id=group_id))
+        self._bump_mutations()  # dict-only write: no _EntityTable involved
         return list(stored)
 
     def list_device_group_elements(self, group_id: str) -> list[DeviceGroupElement]:
@@ -371,7 +429,8 @@ class InMemoryDeviceEventManagement:
     """
 
     def __init__(self, device_management: InMemoryDeviceManagement,
-                 history: int = 1024, cold_retention: int = 100_000):
+                 history: int = 1024, cold_retention: int = 100_000,
+                 durable=None):
         self.dm = device_management
         self.telemetry = TelemetryStore(history=history)
         self.cold_retention = cold_retention
@@ -380,6 +439,46 @@ class InMemoryDeviceEventManagement:
         self.responses: list[DeviceCommandResponse] = []
         self.state_changes: list[DeviceStateChange] = []
         self._events_by_id: dict[str, DeviceEvent] = {}
+        # optional spill log (persistence/durable.DurableEventLog):
+        # every persisted event is teed to disk; replay happens here,
+        # before any consumer runs, so scoring warmup sees recovered
+        # history exactly as if the process had never died
+        self.durable = durable
+        self._replaying = False
+        if durable is not None:
+            self._replay_durable()
+
+    def _replay_durable(self) -> None:
+        from sitewhere_tpu.domain.batch import BatchContext
+
+        ctx = BatchContext(tenant_id="", source="durable-replay")
+        self._replaying = True
+        try:
+            def handler(rtype: int, payload: memoryview) -> None:
+                if rtype == RT_MEASUREMENTS:
+                    self.add_measurements(
+                        MeasurementBatch.decode(payload, ctx))
+                elif rtype == RT_LOCATIONS:
+                    self.add_locations(LocationBatch.decode(payload, ctx))
+                elif rtype == RT_COLD:
+                    from sitewhere_tpu.kernel import codec
+
+                    ev = codec.decode(payload)
+                    if isinstance(ev, DeviceAlert):
+                        self.add_alerts([ev])
+                    elif isinstance(ev, DeviceCommandInvocation):
+                        self.add_command_invocations([ev])
+                    elif isinstance(ev, DeviceCommandResponse):
+                        self.add_command_responses([ev])
+                    elif isinstance(ev, DeviceStateChange):
+                        self.add_state_changes([ev])
+            self.durable.replay(handler)
+        finally:
+            self._replaying = False
+
+    def _spill(self, rtype: int, obj) -> None:
+        if self.durable is not None and not self._replaying:
+            self.durable.submit(rtype, obj)
 
     def _trim(self, lst: list) -> None:
         excess = len(lst) - self.cold_retention
@@ -406,10 +505,14 @@ class InMemoryDeviceEventManagement:
     # -- hot appends -------------------------------------------------------
 
     def add_measurements(self, batch: MeasurementBatch) -> int:
-        return self.telemetry.append_measurements(batch)
+        n = self.telemetry.append_measurements(batch)
+        self._spill(RT_MEASUREMENTS, batch)
+        return n
 
     def add_locations(self, batch: LocationBatch) -> int:
-        return self.telemetry.append_locations(batch)
+        n = self.telemetry.append_locations(batch)
+        self._spill(RT_LOCATIONS, batch)
+        return n
 
     # -- cold appends ------------------------------------------------------
 
@@ -417,6 +520,7 @@ class InMemoryDeviceEventManagement:
         for a in alerts:
             self.alerts.append(a)
             self._events_by_id[a.id] = a
+            self._spill(RT_COLD, a)
         self._trim(self.alerts)
         return list(alerts)
 
@@ -437,6 +541,7 @@ class InMemoryDeviceEventManagement:
         for inv in invocations:
             self.invocations.append(inv)
             self._events_by_id[inv.id] = inv
+            self._spill(RT_COLD, inv)
         self._trim(self.invocations)
         return list(invocations)
 
@@ -444,6 +549,7 @@ class InMemoryDeviceEventManagement:
         for r in responses:
             self.responses.append(r)
             self._events_by_id[r.id] = r
+            self._spill(RT_COLD, r)
         self._trim(self.responses)
         return list(responses)
 
@@ -451,6 +557,7 @@ class InMemoryDeviceEventManagement:
         for c in changes:
             self.state_changes.append(c)
             self._events_by_id[c.id] = c
+            self._spill(RT_COLD, c)
         self._trim(self.state_changes)
         return list(changes)
 

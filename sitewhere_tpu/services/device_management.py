@@ -12,6 +12,7 @@ validates whole batches against the engine's dense `registered` mask
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional
 
 import numpy as np
@@ -22,8 +23,11 @@ from sitewhere_tpu.domain.model import (
     DeviceAssignment,
     DeviceType,
 )
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.persistence.memory import InMemoryDeviceManagement
+
+logger = logging.getLogger(__name__)
 
 
 class DeviceManagementEngine(TenantEngine):
@@ -34,6 +38,55 @@ class DeviceManagementEngine(TenantEngine):
         self.spi = InMemoryDeviceManagement()
         # dense boolean mask over device indices; grown on demand.
         self._registered = np.zeros(1024, dtype=bool)
+        self._snapshot_path: Optional[str] = None
+        import threading
+
+        self._snap_lock = threading.Lock()
+
+    async def _do_initialize(self, monitor) -> None:
+        cfg = self.tenant.section("device-management", {})
+        settings = self.runtime.settings
+        data_dir = cfg.get("data_dir", settings.data_dir)
+        if not data_dir:
+            return
+        import os
+
+        from sitewhere_tpu.persistence.durable import load_snapshot
+
+        tdir = os.path.join(data_dir, "tenants", self.tenant_id)
+        os.makedirs(tdir, exist_ok=True)
+        self._snapshot_path = os.path.join(tdir, "registry.snap")
+        snap = load_snapshot(self._snapshot_path)
+        if snap is not None:
+            self.spi.restore_snapshot(snap)
+            # rebuild the hot-path mask from restored entities
+            for d in self.spi.devices.by_id.values():
+                self._ensure_mask(d.index)
+                self._registered[d.index] = True
+            logger.info("device-management[%s]: restored %d devices from "
+                        "snapshot", self.tenant_id, self.spi.device_count())
+        self.add_child(_RegistrySnapshotter(
+            self, interval_s=cfg.get("snapshot_interval_s", 1.0)))
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        self._save_snapshot()  # clean shutdown: nothing relies on the timer
+
+    def _save_snapshot(self) -> None:
+        if self._snapshot_path is None:
+            return
+        self._write_snapshot(self.spi.to_snapshot())
+
+    def _write_snapshot(self, snap: dict) -> None:
+        """Encode + atomic write. Lock-serialized: the snapshotter's
+        executor save can still be in flight when _do_stop's save runs
+        (task cancellation doesn't stop a worker thread), and two
+        writers interleaving on the same tmp path would install a
+        corrupt snapshot."""
+        from sitewhere_tpu.persistence.durable import save_snapshot
+
+        with self._snap_lock:
+            save_snapshot(self._snapshot_path, snap)
 
     # -- hot path ----------------------------------------------------------
 
@@ -101,6 +154,37 @@ class DeviceManagementEngine(TenantEngine):
     def __getattr__(self, name):
         # non-overridden SPI surface passes straight through
         return getattr(self.spi, name)
+
+
+class _RegistrySnapshotter(BackgroundTaskComponent):
+    """Debounced registry persistence: every `interval_s`, write an
+    atomic snapshot iff the mutation epoch moved. Snapshot cost is a
+    codec encode of the whole registry — O(entities), off the hot path
+    (ingest never touches the registry; it reads the dense mask)."""
+
+    def __init__(self, engine: DeviceManagementEngine,
+                 interval_s: float = 1.0):
+        super().__init__("registry-snapshotter")
+        self.engine = engine
+        self.interval_s = interval_s
+
+    async def _run(self) -> None:
+        import asyncio
+
+        saved_epoch = -1
+        loop = asyncio.get_event_loop()
+        while True:
+            await asyncio.sleep(self.interval_s)
+            epoch = self.engine.spi.mutations
+            if epoch == saved_epoch:
+                continue
+            # collect ON the loop thread (shallow list copies — no dict
+            # can mutate mid-iteration); only codec encode + file IO go
+            # to the executor
+            snap = self.engine.spi.to_snapshot()
+            await loop.run_in_executor(
+                None, self.engine._write_snapshot, snap)
+            saved_epoch = epoch
 
 
 class DeviceManagementService(Service):

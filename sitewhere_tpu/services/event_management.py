@@ -45,9 +45,40 @@ class EventManagementEngine(TenantEngine):
         cfg = self.tenant.section("event-management", {})
         dm = await self.runtime.wait_for_engine("device-management",
                                                 self.tenant_id)
+        durable = None
+        settings = self.runtime.settings
+        data_dir = cfg.get("data_dir", settings.data_dir)
+        if data_dir:
+            import os
+
+            from sitewhere_tpu.persistence.durable import DurableEventLog
+
+            durable = DurableEventLog(
+                os.path.join(data_dir, "tenants", self.tenant_id, "events"),
+                segment_bytes=cfg.get("durable_segment_bytes",
+                                      settings.durable_segment_bytes),
+                max_segments=cfg.get("durable_max_segments",
+                                     settings.durable_max_segments),
+                fsync_interval_s=cfg.get("durable_fsync_interval_s",
+                                         settings.durable_fsync_interval_s))
         self.spi = InMemoryDeviceEventManagement(
             dm, history=cfg.get("history", 1024),
-            cold_retention=cfg.get("cold_retention", 100_000))
+            cold_retention=cfg.get("cold_retention", 100_000),
+            durable=durable)
+        if durable is not None and durable.log._segments():
+            logger.info("event-management[%s]: replayed durable log "
+                        "(%d events now in store)", self.tenant_id,
+                        self.spi.telemetry.total_events)
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        if self.spi is not None and self.spi.durable is not None:
+            # drain + fsync the spill queue off-loop so a clean shutdown
+            # loses nothing (hard kills are bounded by fsync_interval_s)
+            import asyncio
+
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.spi.durable.close)
 
     # -- API surface for other services / REST -----------------------------
 

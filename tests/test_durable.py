@@ -1,0 +1,332 @@
+"""Durable event store + entity snapshots (persistence/durable.py).
+
+The reference's event-management component persists to a durable store
+(Mongo/InfluxDB/Cassandra, [SURVEY.md §2.2]) and treats it as the
+recovery source of truth ([SURVEY.md §5.4]). These tests pin the rebuilt
+contract: segment framing + torn-tail truncation, spill tee + replay,
+registry snapshot round-trip, and a real kill -9 chaos test in which a
+restarted process recovers history, registrations, and scoring.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+from sitewhere_tpu.domain.events import DeviceAlert
+from sitewhere_tpu.persistence.durable import (
+    RT_COLD,
+    RT_MEASUREMENTS,
+    DurableEventLog,
+    SegmentLog,
+    load_snapshot,
+    save_snapshot,
+)
+from sitewhere_tpu.persistence.memory import (
+    InMemoryDeviceEventManagement,
+    InMemoryDeviceManagement,
+)
+from sitewhere_tpu.domain.model import (
+    Device,
+    DeviceAssignment,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceType,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_batch(n=16, base=0.0):
+    ctx = BatchContext(tenant_id="acme", source="test")
+    return MeasurementBatch(
+        ctx,
+        device_index=np.arange(n, dtype=np.uint32),
+        mtype=np.zeros(n, np.uint16),
+        value=(np.arange(n) + base).astype(np.float32),
+        ts=np.full(n, 1000.0 + base, np.float64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# SegmentLog framing
+# ---------------------------------------------------------------------------
+
+class TestSegmentLog:
+    def test_round_trip(self, tmp_path):
+        log = SegmentLog(str(tmp_path))
+        payloads = [bytes([i]) * (i + 1) for i in range(10)]
+        for i, p in enumerate(payloads):
+            log.append(i % 3 + 1, p)
+        log.close()
+        out = list(SegmentLog(str(tmp_path)).replay())
+        assert [bytes(p) for _, p in out] == payloads
+        assert [t for t, _ in out] == [i % 3 + 1 for i in range(10)]
+
+    def test_rotation_and_order(self, tmp_path):
+        log = SegmentLog(str(tmp_path), segment_bytes=256)
+        for i in range(50):
+            log.append(1, f"rec-{i:04d}".encode() * 4)
+        log.close()
+        segs = log._segments()
+        assert len(segs) > 1  # rotated
+        recs = [bytes(p) for _, p in SegmentLog(str(tmp_path)).replay()]
+        assert recs == [f"rec-{i:04d}".encode() * 4 for i in range(50)]
+
+    def test_prune_keeps_newest(self, tmp_path):
+        log = SegmentLog(str(tmp_path), segment_bytes=128, max_segments=3)
+        for i in range(100):
+            log.append(1, f"{i:06d}".encode() * 3)
+        log.close()
+        assert len(log._segments()) <= 4  # 3 sealed + active
+        recs = [bytes(p) for _, p in SegmentLog(str(tmp_path)).replay()]
+        # oldest pruned, newest survive, order preserved
+        assert recs[-1] == b"000099" * 3
+        nums = [int(r[:6]) for r in recs]
+        assert nums == sorted(nums)
+
+    def test_torn_tail_truncated(self, tmp_path):
+        log = SegmentLog(str(tmp_path))
+        log.append(1, b"good-record")
+        log.append(1, b"second-good")
+        log.close()
+        seg = log._segments()[-1][1]
+        with open(seg, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xef\x01torn")  # lies
+        recs = [bytes(p) for _, p in SegmentLog(str(tmp_path)).replay()]
+        assert recs == [b"good-record", b"second-good"]
+
+    def test_crc_corruption_truncates(self, tmp_path):
+        log = SegmentLog(str(tmp_path))
+        log.append(1, b"aaaa")
+        log.append(1, b"bbbb")
+        log.close()
+        seg = log._segments()[-1][1]
+        data = bytearray(open(seg, "rb").read())
+        data[-1] ^= 0xFF  # flip a payload byte of the second record
+        open(seg, "wb").write(bytes(data))
+        recs = [bytes(p) for _, p in SegmentLog(str(tmp_path)).replay()]
+        assert recs == [b"aaaa"]
+
+    def test_new_writer_appends_new_segment(self, tmp_path):
+        log = SegmentLog(str(tmp_path))
+        log.append(1, b"first-life")
+        log.close()
+        log2 = SegmentLog(str(tmp_path))
+        log2.append(1, b"second-life")
+        log2.close()
+        recs = [bytes(p) for _, p in SegmentLog(str(tmp_path)).replay()]
+        assert recs == [b"first-life", b"second-life"]
+
+
+# ---------------------------------------------------------------------------
+# DurableEventLog (threaded tee) + SPI replay
+# ---------------------------------------------------------------------------
+
+class TestDurableEventLog:
+    def test_submit_encode_replay(self, tmp_path):
+        dlog = DurableEventLog(str(tmp_path))
+        batch = mk_batch(8)
+        alert = DeviceAlert(device_id="d1", message="hot")
+        dlog.submit(RT_MEASUREMENTS, batch)
+        dlog.submit(RT_COLD, alert)
+        dlog.close()
+        assert dlog.written == 2 and dlog.dropped == 0
+        got = []
+        DurableEventLog(str(tmp_path)).replay(
+            lambda t, p: got.append((t, bytes(p))))
+        assert [t for t, _ in got] == [RT_MEASUREMENTS, RT_COLD]
+        dec = MeasurementBatch.decode(got[0][1],
+                                      BatchContext(tenant_id="acme"))
+        np.testing.assert_array_equal(dec.value, batch.value)
+
+    def test_spi_tee_and_replay(self, tmp_path):
+        dm = InMemoryDeviceManagement()
+        em = InMemoryDeviceEventManagement(
+            dm, history=64, durable=DurableEventLog(str(tmp_path)))
+        for k in range(5):
+            em.add_measurements(mk_batch(16, base=k * 100.0))
+        em.add_alerts([DeviceAlert(device_id="d0", message="boom")])
+        em.durable.close()
+
+        # second life: same dir, fresh stores
+        em2 = InMemoryDeviceEventManagement(
+            InMemoryDeviceManagement(), history=64,
+            durable=DurableEventLog(str(tmp_path)))
+        assert em2.telemetry.total_events == 5 * 16
+        w, valid = em2.telemetry.window(np.arange(16), 5)
+        # per-device window = the 5 appended values in order
+        np.testing.assert_allclose(w[3], [3, 103, 203, 303, 403])
+        assert valid.all()
+        assert em2.alerts[0].message == "boom"
+        # replay does not re-log: the log still holds exactly 6 records
+        n = sum(1 for _ in em2.durable.log.replay())
+        em2.durable.close()
+        assert n == 6
+
+
+# ---------------------------------------------------------------------------
+# Registry snapshots
+# ---------------------------------------------------------------------------
+
+class TestRegistrySnapshot:
+    def test_round_trip(self, tmp_path):
+        dm = InMemoryDeviceManagement()
+        dt = dm.create_device_type(DeviceType(token="thermo", name="T"))
+        devs = [dm.create_device(Device(token=f"d{i}",
+                                        device_type_id=dt.id))
+                for i in range(10)]
+        dm.create_device_assignment(
+            DeviceAssignment(device_id=devs[0].id, token="a0"))
+        g = dm.create_device_group(DeviceGroup(token="g1", name="G"))
+        dm.add_device_group_elements(
+            g.id, [DeviceGroupElement(device_id=devs[1].id)])
+        path = str(tmp_path / "registry.snap")
+        save_snapshot(path, dm.to_snapshot())
+
+        dm2 = InMemoryDeviceManagement()
+        dm2.restore_snapshot(load_snapshot(path))
+        assert dm2.device_count() == 10
+        assert dm2.get_device_by_token("d3").index == devs[3].index
+        assert dm2.get_device_by_index(devs[3].index).token == "d3"
+        assert len(dm2.get_active_assignments_for_device(devs[0].id)) == 1
+        assert dm2.expand_group_devices(g.id)[0].token == "d1"
+        # index counter advanced past restored devices: no index reuse
+        d_new = dm2.create_device(Device(token="new",
+                                         device_type_id=dt.id))
+        assert d_new.index == 10
+
+    def test_corrupt_snapshot_ignored(self, tmp_path):
+        path = str(tmp_path / "registry.snap")
+        save_snapshot(path, {"tables": {}, "next_index": 0,
+                             "group_elements": {}})
+        data = bytearray(open(path, "rb").read())
+        data[-1] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        assert load_snapshot(path) is None
+
+    def test_missing_snapshot_none(self, tmp_path):
+        assert load_snapshot(str(tmp_path / "nope.snap")) is None
+
+    def test_mutation_epoch(self):
+        dm = InMemoryDeviceManagement()
+        e0 = dm.mutations
+        dt = dm.create_device_type(DeviceType(token="t"))
+        assert dm.mutations > e0
+        e1 = dm.mutations
+        dm.create_device(Device(token="d", device_type_id=dt.id))
+        assert dm.mutations > e1
+
+
+# ---------------------------------------------------------------------------
+# Chaos: kill -9 mid-stream, restart, recover
+# ---------------------------------------------------------------------------
+
+CHAOS_CHILD = r"""
+import asyncio, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+import numpy as np
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.model import DeviceType
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.services import (
+    DeviceManagementService, EventSourcesService, InboundProcessingService,
+    EventManagementService, DeviceStateService, RuleProcessingService)
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+MODE = sys.argv[1]
+DATA = sys.argv[2]
+
+async def main():
+    rt = ServiceRuntime(InstanceSettings(instance_id="chaos",
+                                         data_dir=DATA,
+                                         engine_ready_timeout_s=60))
+    for cls in (DeviceManagementService, EventSourcesService,
+                InboundProcessingService, EventManagementService,
+                DeviceStateService, RuleProcessingService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    await rt.add_tenant(TenantConfig(tenant_id="acme", sections={{
+        "event-management": {{"history": 64}},
+        "rule-processing": {{"model": "zscore",
+                           "model_config": {{"window": 8}},
+                           "threshold": 4.0, "batch_window_ms": 1.0,
+                           "buckets": [64], "capacity": 64}},
+    }}))
+    dm = rt.api("device-management").management("acme")
+    em = rt.api("event-management").management("acme")
+    eng = rt.api("rule-processing").engine("acme")
+
+    if MODE == "first":
+        dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), 64)
+        sim = DeviceSimulator(SimConfig(num_devices=64), tenant_id="acme")
+        for k in range(20):
+            batch, _ = sim.tick(t=1000.0 + k)
+            em.add_measurements(batch)
+        # wait for the registry snapshotter's debounce + spill fsync
+        await asyncio.sleep(1.6)
+        print("READY-TO-KILL", flush=True)
+        await asyncio.sleep(60)   # parent SIGKILLs us here
+    else:
+        # second life: everything must be back before any new ingest
+        assert dm.device_count() == 64, dm.device_count()
+        assert em.telemetry.total_events == 20 * 64, em.telemetry.total_events
+        w, valid = em.telemetry.window(np.arange(64), 8)
+        assert valid.all()
+        # scoring session warms from the REPLAYED store
+        while not eng.session.ready:
+            await asyncio.sleep(0.05)
+        eng.session.reload_history()
+        x, v = eng.session.ring.windows(np.arange(4))
+        assert np.asarray(v).all(), "ring not warmed from replayed history"
+        # pipeline still ingests after recovery
+        sim = DeviceSimulator(SimConfig(num_devices=64), tenant_id="acme")
+        batch, _ = sim.tick(t=2000.0)
+        em.add_measurements(batch)
+        assert em.telemetry.total_events == 21 * 64
+        print("RECOVERED-OK", flush=True)
+        await rt.stop()
+
+asyncio.run(main())
+"""
+
+
+def test_kill9_recovery(tmp_path):
+    """Hard-kill the process mid-stream; a restart recovers registrations,
+    event history, and scoring warm-state from disk."""
+    child_src = CHAOS_CHILD.format(repo=REPO)
+    script = tmp_path / "chaos_child.py"
+    script.write_text(child_src)
+    data = str(tmp_path / "data")
+
+    p = subprocess.Popen([sys.executable, str(script), "first", data],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO)
+    try:
+        deadline = time.monotonic() + 60
+        for line in p.stdout:
+            if "READY-TO-KILL" in line:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("first life never became ready")
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=10)
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+    out = subprocess.run([sys.executable, str(script), "second", data],
+                         capture_output=True, text=True, timeout=90,
+                         cwd=REPO)
+    assert "RECOVERED-OK" in out.stdout, (
+        f"stdout: {out.stdout!r}\nstderr: {out.stderr[-3000:]!r}")
