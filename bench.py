@@ -195,6 +195,17 @@ def run_supervised(args, argv: list) -> int:
                    for a in argv):
             cpu_extra_args.append("--devices")
             cpu_extra_args.append("4096")
+        # ... and the CPU-shaped pace. The latency phase paces at a
+        # fraction of FLOOD saturation, where batching amortizes per-
+        # flush cost; on a 1-core host the zero-queue knee is lower —
+        # measured: 0.5 × saturation queues systemically (admit p50
+        # 40 ms, r04's 63 ms p99), 0.3 holds the pipeline-owned p99
+        # budget (~6 ms) with healthy p50s. TPU keeps 0.5 (tail there
+        # is the tunnel RTT, not queueing).
+        if not any(a == "--paced-fraction"
+                   or a.startswith("--paced-fraction=") for a in argv):
+            cpu_extra_args.append("--paced-fraction")
+            cpu_extra_args.append("0.3")
 
     if force_cpu:
         _cpu_shape_fleet()
@@ -709,6 +720,14 @@ async def run_bench(args) -> dict:
 
     platform, device_kind, n_chips = probe_backend()
 
+    if args.durable:
+        # fresh dir per run: a restored registry would collide with
+        # bootstrap_fleet's tokens (and a replayed log would contaminate
+        # the measurement — the bench measures spill cost, not recovery)
+        import shutil
+
+        shutil.rmtree(args.durable, ignore_errors=True)
+        os.makedirs(args.durable, exist_ok=True)
     rt = ServiceRuntime(InstanceSettings(
         instance_id="bench", engine_ready_timeout_s=args.ready_timeout,
         data_dir=args.durable))
@@ -724,6 +743,12 @@ async def run_bench(args) -> dict:
     tenant_ids = ([f"bench{i}" for i in range(args.pooled)] if pooled
                   else ["bench"])
     per_tenant = max(args.devices // len(tenant_ids), 1)
+    # ONE fleet-size bucket: throughput is inflight × bucket / RTT on the
+    # tunneled chip (bigger flushes win) and every extra bucket is another
+    # warmup compile. (A CPU bucket ladder was tried for the latency
+    # phase and measured WORSE — on a small host many small XLA calls
+    # lose to one padded call; the latency fix is smooth pacing below.)
+    buckets = [per_tenant]
     for tid in tenant_ids:
         await rt.add_tenant(TenantConfig(tenant_id=tid, sections={
             "event-management": {"history": args.history},
@@ -732,7 +757,7 @@ async def run_bench(args) -> dict:
                 "model_config": {"window": args.window},
                 "threshold": 6.0,
                 "batch_window_ms": args.window_ms,
-                "buckets": [per_tenant],  # fleet bucket: 1 flush = 1 XLA call
+                "buckets": buckets,  # fleet bucket: 1 flush = 1 XLA call
                 "capacity": per_tenant,   # pre-size the ring: no regrow
                 "max_inflight": args.max_inflight,
                 "shared": pooled,
@@ -939,6 +964,13 @@ async def run_bench(args) -> dict:
         "p99_ms": round(p99 * 1e3, 3),
         "p50_ms": round(p50 * 1e3, 3),
         "p99_breakdown": breakdown,
+        # the <10 ms north-star budget is on the PIPELINE-owned stages
+        # (admit+batch+sink — the device stage's floor is the host↔chip
+        # RTT on a tunneled rig, or core-sharing on CPU); self-report it
+        # so every artifact answers the budget question directly
+        "pipeline_owned_p99_ms": round(
+            sum(breakdown[k]["p99_ms"]
+                for k in ("admit", "batch", "sink") if k in breakdown), 3),
         "paced_rate": round(paced_rate, 1),
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),

@@ -228,25 +228,39 @@ class DurableEventLog:
                 rtype, obj = self._q.get(
                     timeout=self.log.fsync_interval_s)
             except queue.Empty:
-                self.log._sync()
+                try:
+                    self.log._sync()
+                except OSError:  # disk fault: keep the thread alive
+                    logger.warning("spill fsync failed", exc_info=True)
                 continue
             try:
                 self.log.append(rtype, self._encode(rtype, obj))
                 self.written += 1
-            except Exception:  # noqa: BLE001 - spill must never kill ingest
+                # unconditional: _sync rate-limits its own fsync, but
+                # the flush must happen per record — otherwise sustained
+                # ingest (queue never empty) leaves data in the
+                # userspace buffer until segment rotation and a kill -9
+                # loses far more than the fsync_interval_s window
+                self.log._sync()
+            except Exception:  # noqa: BLE001 - spill must never kill
+                # ingest, and a writer thread that dies on a disk fault
+                # would silently end ALL durability while the process
+                # keeps reporting itself durable
                 logger.warning("spill write failed; record lost",
                                exc_info=True)
-            # unconditional: _sync rate-limits its own fsync, but the
-            # flush must happen per record — otherwise sustained ingest
-            # (queue never empty) leaves data in the userspace buffer
-            # until segment rotation and a kill -9 loses far more than
-            # the documented fsync_interval_s window
-            self.log._sync()
-        self.log.close()
+        try:
+            self.log.close()
+        except OSError:
+            logger.warning("spill close failed", exc_info=True)
 
     def close(self, timeout: float = 10.0) -> None:
         self._closed.set()
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            logger.warning(
+                "spill writer still draining after %.0fs — a clean "
+                "shutdown may lose queued records (disk too slow?)",
+                timeout)
 
     def replay(self, handler: Callable[[int, memoryview], None]) -> int:
         """Feed every recovered record to `handler`; returns count."""

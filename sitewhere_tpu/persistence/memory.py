@@ -149,7 +149,12 @@ class InMemoryDeviceManagement:
     def restore_snapshot(self, snap: dict) -> None:
         """Rebuild every table and derived index from `to_snapshot()`
         output. Active-assignment lists are derived from assignment
-        status; device index maps from the entities themselves."""
+        status; device index maps from the entities themselves.
+        Idempotent: derived maps are rebuilt from scratch so an engine
+        restart() re-running initialization never duplicates entries."""
+        self._token_to_index = {}
+        self._index_to_device_id = {}
+        self._active_assignment_by_device = {}
         for name in self._TABLES:
             table = getattr(self, name)
             for entity in snap["tables"].get(name, []):

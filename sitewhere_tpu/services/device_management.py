@@ -59,14 +59,18 @@ class DeviceManagementEngine(TenantEngine):
         snap = load_snapshot(self._snapshot_path)
         if snap is not None:
             self.spi.restore_snapshot(snap)
-            # rebuild the hot-path mask from restored entities
+            # rebuild the hot-path mask from restored entities — status
+            # included: a device deactivated before the crash must not
+            # resurrect as registered
             for d in self.spi.devices.by_id.values():
                 self._ensure_mask(d.index)
-                self._registered[d.index] = True
+                self._registered[d.index] = d.status == "active"
             logger.info("device-management[%s]: restored %d devices from "
                         "snapshot", self.tenant_id, self.spi.device_count())
-        self.add_child(_RegistrySnapshotter(
-            self, interval_s=cfg.get("snapshot_interval_s", 1.0)))
+        if not any(isinstance(c, _RegistrySnapshotter)
+                   for c in self._children):  # restart(): never two loops
+            self.add_child(_RegistrySnapshotter(
+                self, interval_s=cfg.get("snapshot_interval_s", 1.0)))
 
     async def _do_stop(self, monitor) -> None:
         await super()._do_stop(monitor)

@@ -224,6 +224,49 @@ class TestRegistrySnapshot:
         assert dm.mutations > e1
 
 
+def test_engine_restore_respects_device_status(tmp_path, run):
+    """A device deactivated before the crash must not resurrect as
+    registered after restore (the mask is rebuilt from entity status)."""
+    from sitewhere_tpu.config import InstanceSettings, TenantConfig
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.services import DeviceManagementService
+
+    async def life(data_dir, first):
+        rt = ServiceRuntime(InstanceSettings(instance_id="t",
+                                             data_dir=data_dir))
+        rt.add_service(DeviceManagementService(rt))
+        await rt.start()
+        await rt.add_tenant(TenantConfig(tenant_id="acme", sections={}))
+        dm = rt.api("device-management").management("acme")
+        if first:
+            devs = dm.bootstrap_fleet(DeviceType(token="thermo"), 4)
+            dm.set_device_status(devs[2].id, "inactive")
+            mask = dm.registered_mask(np.arange(4))
+            assert list(mask) == [True, True, False, True]
+        else:
+            mask = dm.registered_mask(np.arange(4))
+            assert list(mask) == [True, True, False, True], list(mask)
+        await rt.stop()
+
+    data = str(tmp_path / "data")
+    run(life(data, True))
+    run(life(data, False))
+
+
+def test_restore_snapshot_idempotent():
+    """restart() re-runs restore into live state; derived maps must not
+    duplicate (active assignments doubled was the failure mode)."""
+    dm = InMemoryDeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    d = dm.create_device(Device(token="d0", device_type_id=dt.id))
+    dm.create_device_assignment(DeviceAssignment(device_id=d.id,
+                                                 token="a0"))
+    snap = dm.to_snapshot()
+    dm.restore_snapshot(snap)
+    dm.restore_snapshot(snap)
+    assert len(dm.get_active_assignments_for_device(d.id)) == 1
+
+
 # ---------------------------------------------------------------------------
 # Chaos: kill -9 mid-stream, restart, recover
 # ---------------------------------------------------------------------------

@@ -14,6 +14,14 @@ log "watcher started pid=$$"
 
 # ---- phase 1: probe until healthy ----
 while true; do
+  # yield to any running bench (mine or the driver's): a probe's jax
+  # import steals enough of this 1-core VM to poison latency tails,
+  # and a concurrent TPU process would wedge the tunnel for both
+  if pgrep -f "python bench\.py" > /dev/null 2>&1; then
+    log "bench running; probe skipped"
+    sleep 120
+    continue
+  fi
   if timeout 150 python bench.py --probe-only > "$OUT/probe.json" 2> "$OUT/probe.err"; then
     if grep -q '"platform": "tpu"' "$OUT/probe.json"; then
       log "HEALTHY: $(cat "$OUT/probe.json")"
