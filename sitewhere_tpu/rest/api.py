@@ -373,6 +373,18 @@ class RestServer(LifecycleComponent):
           self.put_decoder_script, AUTH_ADMIN_SCRIPTS)
         r("DELETE", r"/api/decoder-scripts/(?P<name>[^/]+)",
           self.delete_decoder_script, AUTH_ADMIN_SCRIPTS)
+        r("GET", r"/api/connector-scripts", self.list_connector_scripts,
+          AUTH_ADMIN_SCRIPTS)
+        r("PUT", r"/api/connector-scripts/(?P<name>[^/]+)",
+          self.put_connector_script, AUTH_ADMIN_SCRIPTS)
+        r("DELETE", r"/api/connector-scripts/(?P<name>[^/]+)",
+          self.delete_connector_script, AUTH_ADMIN_SCRIPTS)
+        r("GET", r"/api/encoder-scripts", self.list_encoder_scripts,
+          AUTH_ADMIN_SCRIPTS)
+        r("PUT", r"/api/encoder-scripts/(?P<name>[^/]+)",
+          self.put_encoder_script, AUTH_ADMIN_SCRIPTS)
+        r("DELETE", r"/api/encoder-scripts/(?P<name>[^/]+)",
+          self.delete_encoder_script, AUTH_ADMIN_SCRIPTS)
         # event-source receivers (dynamic source management; a decoder
         # script's delete-409 is resolvable through this surface)
         r("GET", r"/api/eventsources/receivers", self.list_receivers,
@@ -381,6 +393,14 @@ class RestServer(LifecycleComponent):
           AUTH_ADMIN_SCRIPTS)
         r("DELETE", r"/api/eventsources/receivers/(?P<name>[^/]+)",
           self.delete_receiver, AUTH_ADMIN_SCRIPTS)
+        # outbound connectors (dynamic sink management; a connector
+        # script's delete-409 is resolvable through this surface)
+        r("GET", r"/api/connectors", self.list_connectors,
+          AUTH_ADMIN_SCRIPTS)
+        r("POST", r"/api/connectors", self.add_connector,
+          AUTH_ADMIN_SCRIPTS)
+        r("DELETE", r"/api/connectors/(?P<name>[^/]+)",
+          self.delete_connector, AUTH_ADMIN_SCRIPTS)
         # labels
         r("GET", r"/api/labels/devices/(?P<token>[^/]+)", self.device_label)
 
@@ -991,6 +1011,59 @@ class RestServer(LifecycleComponent):
     async def delete_decoder_script(self, req: Request):
         return self._script_delete(req, "event-sources",
                                    lambda e: e.delete_decoder_script)
+
+    async def list_connector_scripts(self, req: Request):
+        return self._script_list(req, "outbound-connectors",
+                                 lambda e: e.connector_scripts)
+
+    async def put_connector_script(self, req: Request):
+        return self._script_put(req, "outbound-connectors",
+                                lambda e: e.put_connector_script)
+
+    async def delete_connector_script(self, req: Request):
+        return self._script_delete(req, "outbound-connectors",
+                                   lambda e: e.delete_connector_script)
+
+    async def list_encoder_scripts(self, req: Request):
+        return self._script_list(req, "command-delivery",
+                                 lambda e: e.encoder_scripts)
+
+    async def put_encoder_script(self, req: Request):
+        return self._script_put(req, "command-delivery",
+                                lambda e: e.put_encoder_script)
+
+    async def delete_encoder_script(self, req: Request):
+        return self._script_delete(req, "command-delivery",
+                                   lambda e: e.delete_encoder_script)
+
+    # -- handlers: outbound connectors --------------------------------------
+
+    async def list_connectors(self, req: Request):
+        engine = self._engine(req, "outbound-connectors")
+        return [{"name": c.name, "kind": type(c).__name__,
+                 "script": getattr(c, "script_name", None)}
+                for c in engine.connectors.values()]
+
+    async def add_connector(self, req: Request):
+        engine = self._engine(req, "outbound-connectors")
+        b = req.json()
+        if b.get("name") in engine.connectors:
+            raise HttpError(409, f"connector {b.get('name')!r} exists")
+        try:
+            conn = engine.add_connector_config(b)
+        except (KeyError, ValueError, OSError) as exc:
+            # OSError: e.g. a jsonl path that can't be opened — the
+            # client's config problem, not a server fault
+            raise HttpError(400, f"bad connector config: {exc}") from exc
+        return {"name": conn.name, "kind": type(conn).__name__}
+
+    async def delete_connector(self, req: Request):
+        engine = self._engine(req, "outbound-connectors")
+        try:
+            engine.remove_connector(req.params["name"])
+        except KeyError as exc:
+            raise HttpError(404, str(exc)) from exc
+        return {"deleted": req.params["name"]}
 
     # -- handlers: event-source receivers -----------------------------------
 

@@ -64,6 +64,31 @@ class Swb1CommandEncoder:
                 + struct.pack("<I", len(params)) + params)
 
 
+class ScriptedCommandEncoder:
+    """Tenant-scripted command encoder (reference analog: the Groovy
+    ICommandExecutionEncoder beside the Groovy decoder/connector
+    scripts): the operator uploads a python script defining
+
+        def encode(device, command, invocation) -> bytes
+
+    and routes device types to it with {"encoder": "script:<name>"}.
+    The manager is consulted per encode, so a script upload hot-swaps
+    the wire format mid-stream — a proprietary downlink framing gets
+    first-class delivery without forking the platform."""
+
+    def __init__(self, manager, name: str):
+        self._manager = manager
+        self._name = name
+
+    def encode(self, device, command, invocation) -> bytes:
+        out = self._manager.hook(self._name)(device, command, invocation)
+        if not isinstance(out, (bytes, bytearray)):
+            raise ValueError(
+                f"encoder script {self._name!r} must return bytes, "
+                f"got {type(out).__name__}")
+        return bytes(out)
+
+
 class DeliveryProvider(Protocol):
     """(reference: ICommandDeliveryProvider)"""
 
@@ -212,8 +237,43 @@ class CommandDeliveryEngine(TenantEngine):
         self.default_encoder = cfg.get("encoder", "json")
         self.default_provider = cfg.get("provider", "queue")
         self.routes: dict[str, dict] = cfg.get("routes", {})
+        # encoder scripts (reference: Groovy command encoder): routed as
+        # "script:<name>", hot-reloadable per encode
+        from sitewhere_tpu.kernel.scripting import ScriptManager
+
+        self.encoder_scripts = ScriptManager(
+            self.tenant_id, entrypoint="encode", require_async=False)
+        for name, source in cfg.get("scripts", {}).items():
+            self.encoder_scripts.put(name, source)
         self.manager = CommandDeliveryManager(self)
         self.add_child(self.manager)
+
+    def put_encoder_script(self, name: str, source: str):
+        """Upload/hot-reload an encoder script (routes using
+        `script:<name>` pick the new version up on their next encode)."""
+        return self.encoder_scripts.put(name, source)
+
+    def delete_encoder_script(self, name: str):
+        """Delete an encoder script — refused while a route (or the
+        tenant default) still references it."""
+        ref = f"script:{name}"
+        users = [t for t, r in self.routes.items()
+                 if r.get("encoder") == ref]
+        if self.default_encoder == ref:
+            users.append("<default>")
+        if users:
+            raise ValueError(
+                f"encoder script {name!r} is routed by {users}; "
+                "re-route first")
+        return self.encoder_scripts.delete(name)
+
+    def _resolve_encoder(self, name: str) -> CommandEncoder:
+        if name.startswith("script:"):
+            sname = name[len("script:"):]
+            if self.encoder_scripts.get(sname) is None:
+                raise KeyError(f"unknown encoder script {sname!r}")
+            return ScriptedCommandEncoder(self.encoder_scripts, sname)
+        return self.encoders[name]
 
     def register_provider(self, name: str, provider: DeliveryProvider) -> None:
         """Extension point for MQTT/CoAP/SMS-style providers."""
@@ -225,7 +285,7 @@ class CommandDeliveryEngine(TenantEngine):
     def route(self, device_type_token: str) -> tuple[CommandEncoder, DeliveryProvider]:
         """(reference: ICommandRouter) resolve encoder+provider for a type."""
         r = self.routes.get(device_type_token, {})
-        enc = self.encoders[r.get("encoder", self.default_encoder)]
+        enc = self._resolve_encoder(r.get("encoder", self.default_encoder))
         prov = self.providers[r.get("provider", self.default_provider)]
         return enc, prov
 

@@ -138,6 +138,10 @@ class Connector:
     async def sink(self, value) -> None:  # pragma: no cover - override
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release held resources (files, sockets). Called on REST
+        detach and at engine stop; base is a no-op."""
+
 
 class MemoryConnector(Connector):
     def __init__(self, name: str, filter: Optional[EventFilter] = None,
@@ -262,6 +266,47 @@ class WebhookConnector(Connector):
         await self.bus.produce(self.dead_letter_topic, value, key=self.name)
 
 
+class ConnectorApi:
+    """Bindings handed to connector scripts (reference analog: the
+    Groovy connector's binding set): bus republish, per-script
+    persistent state, and a logger — enough to build counters,
+    transforms, and bridges without platform access."""
+
+    def __init__(self, engine: "OutboundConnectorsEngine", name: str):
+        self._engine = engine
+        self.tenant_id = engine.tenant_id
+        self.state: dict = {}
+        self.log = logging.getLogger(f"swx.connector-script.{name}")
+
+    async def produce(self, topic: str, value) -> None:
+        await self._engine.runtime.bus.produce(topic, value)
+
+
+class ScriptedConnector(Connector):
+    """Tenant-scripted outbound connector (reference analog:
+    GroovyEventConnector beside the Groovy decoder/rule scripts): the
+    operator uploads a python script defining
+
+        async def sink(record: dict, api) -> None
+
+    `record` is the jsonable view of the enriched/scored record (same
+    shape the jsonl/webhook connectors emit); `api` is a ConnectorApi.
+    The manager is consulted per record, so a script upload hot-swaps
+    the connector mid-stream; per-connector `api.state` survives
+    reloads (versioned logic, persistent counters)."""
+
+    def __init__(self, name: str, script_name: str, engine,
+                 filter: Optional[EventFilter] = None):
+        super().__init__(name, filter)
+        self.script_name = script_name
+        self._engine = engine
+        self.api = ConnectorApi(engine, name)
+
+    async def sink(self, value) -> None:
+        fn = self._engine.connector_scripts.hook(self.script_name)
+        await fn(record_to_jsonable(value), self.api)
+
+
 class MqttRepublishConnector(Connector):
     """Republish (filtered) records as JSON out through the tenant's
     MQTT broker endpoint: one PUBLISH on `<topic_prefix><kind>` per
@@ -293,17 +338,48 @@ class OutboundConnectorsEngine(TenantEngine):
         super().__init__(service, tenant)
         self.connectors: dict[str, Connector] = {}
         cfg = tenant.section("outbound-connectors", {})
+        # connector scripts (reference: GroovyEventConnector): uploaded
+        # per tenant, hot-reloadable, bound by connectors with
+        # {"kind": "script", "script": "<name>"}
+        from sitewhere_tpu.kernel.scripting import ScriptManager
+
+        self.connector_scripts = ScriptManager(
+            self.tenant_id, entrypoint="sink", require_async=True)
+        for name, source in cfg.get("scripts", {}).items():
+            self.connector_scripts.put(name, source)
         for c in cfg.get("connectors", []):
             self.add_connector_config(c)
         self.manager = OutboundManager(self)
         self.add_child(self.manager)
+
+    def put_connector_script(self, name: str, source: str):
+        """Upload/hot-reload a connector script (live connectors bound
+        to it pick the new version up on their next record)."""
+        return self.connector_scripts.put(name, source)
+
+    def delete_connector_script(self, name: str):
+        """Delete a connector script — refused while a live connector
+        still references it."""
+        users = [c.name for c in self.connectors.values()
+                 if isinstance(c, ScriptedConnector)
+                 and c.script_name == name]
+        if users:
+            raise ValueError(
+                f"connector script {name!r} is in use by connector(s) "
+                f"{users}; remove them first")
+        return self.connector_scripts.delete(name)
 
     def add_connector_config(self, c: dict) -> Connector:
         filt = EventFilter(kinds=c.get("kinds"),
                           device_indices=c.get("devices"),
                           min_score=c.get("min_score"))
         kind = c.get("kind", "memory")
-        name = c.get("name", f"{kind}-{len(self.connectors)}")
+        name = c.get("name")
+        if not name:  # generated names must never collide/replace
+            i = len(self.connectors)
+            while f"{kind}-{i}" in self.connectors:
+                i += 1
+            name = f"{kind}-{i}"
         if kind == "memory":
             conn = MemoryConnector(name, filt, retention=c.get("retention", 1000))
         elif kind == "jsonl":
@@ -339,6 +415,14 @@ class OutboundConnectorsEngine(TenantEngine):
                 name, listener_fn,
                 topic_prefix=c.get("topic_prefix", "swx/outbound/"),
                 filter=filt, retain=c.get("retain", False))
+        elif kind == "script":
+            script_name = c["script"]
+            if self.connector_scripts.get(script_name) is None:
+                raise ValueError(
+                    f"connector references unknown script {script_name!r}"
+                    " — upload it first (PUT /api/connector-scripts/"
+                    f"{script_name})")
+            conn = ScriptedConnector(name, script_name, self, filt)
         else:
             raise ValueError(f"unknown connector kind {kind!r}")
         self.connectors[name] = conn
@@ -347,6 +431,13 @@ class OutboundConnectorsEngine(TenantEngine):
     def add_connector(self, connector: Connector) -> None:
         """Extension point for custom (e.g. MQTT) connectors."""
         self.connectors[connector.name] = connector
+
+    def remove_connector(self, name: str) -> Connector:
+        conn = self.connectors.pop(name, None)
+        if conn is None:
+            raise KeyError(f"unknown connector {name!r}")
+        conn.close()
+        return conn
 
 
 class OutboundManager(BackgroundTaskComponent):
@@ -366,7 +457,9 @@ class OutboundManager(BackgroundTaskComponent):
         try:
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
-                    for connector in engine.connectors.values():
+                    # snapshot: REST add/delete mutates the dict while
+                    # process() is suspended; a live iterator would die
+                    for connector in list(engine.connectors.values()):
                         try:
                             await connector.process(record.value)
                         except Exception:  # noqa: BLE001 - connector isolated
@@ -380,8 +473,7 @@ class OutboundManager(BackgroundTaskComponent):
     async def _do_stop(self, monitor) -> None:
         await super()._do_stop(monitor)
         for connector in self.engine.connectors.values():
-            if isinstance(connector, JsonlConnector):
-                connector.close()
+            connector.close()
 
 
 class OutboundConnectorsService(Service):

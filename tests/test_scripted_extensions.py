@@ -1,0 +1,276 @@
+"""Scripted outbound connectors + scripted command encoders.
+
+Completes the reference's four Groovy hook points ([SURVEY.md §2.2]:
+decoders, rule processors, outbound connectors, command encoders) —
+decoders/rules landed earlier; these tests pin the last two: same
+tenant script store contract, hot reload mid-stream, REST CRUD with
+delete-in-use refusal.
+"""
+
+import asyncio
+import json
+
+from sitewhere_tpu.domain.events import DeviceCommandInvocation
+from sitewhere_tpu.domain.model import DeviceCommand
+
+from tests.test_pipeline import wait_until
+from tests.test_services_full import full_instance
+
+CONNECTOR_V1 = """
+async def sink(record, api):
+    api.state.setdefault("seen", []).append(("v1", record["kind"]))
+"""
+
+CONNECTOR_V2 = """
+async def sink(record, api):
+    api.state.setdefault("seen", []).append(("v2", record["kind"]))
+"""
+
+CONNECTOR_REPUBLISH = """
+async def sink(record, api):
+    await api.produce("custom.sink." + api.tenant_id, record)
+"""
+
+ENCODER_V1 = """
+def encode(device, command, invocation):
+    name = command.name if command else invocation.command_id
+    return ("CSV1," + device.token + "," + name).encode()
+"""
+
+ENCODER_V2 = """
+def encode(device, command, invocation):
+    name = command.name if command else invocation.command_id
+    return ("CSV2," + device.token + "," + name).encode()
+"""
+
+
+def _ingest_measurements(rt, n=8):
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    sim = DeviceSimulator(SimConfig(num_devices=n), tenant_id="acme")
+    em = rt.api("event-management").management("acme")
+
+    async def tick(t):
+        batch, _ = sim.tick(t=t)
+        await em.runtime.bus.produce(
+            em.tenant_topic("inbound-events"), batch, key="sim")
+    return tick
+
+
+def test_scripted_connector_e2e_and_hot_swap(run):
+    """A scripted connector receives enriched records through the REAL
+    outbound consumer; uploading v2 mid-stream hot-swaps the logic while
+    api.state survives the reload."""
+    async def main():
+        sections = {"outbound-connectors": {
+            "scripts": {"collect": CONNECTOR_V1},
+            "connectors": [
+                {"kind": "script", "name": "sc", "script": "collect",
+                 "kinds": ["measurements"]},
+            ]}}
+        async with full_instance(sections) as rt:
+            out = rt.api("outbound-connectors").engine("acme")
+            conn = out.connectors["sc"]
+            tick = _ingest_measurements(rt)
+            await tick(1000.0)
+            await wait_until(lambda: conn.api.state.get("seen"))
+            assert conn.api.state["seen"][0] == ("v1", "measurements")
+
+            # hot swap mid-stream: v2 applies to the NEXT record,
+            # state survives
+            out.put_connector_script("collect", CONNECTOR_V2)
+            await tick(1001.0)
+            await wait_until(
+                lambda: ("v2", "measurements") in conn.api.state["seen"])
+            assert ("v1", "measurements") in conn.api.state["seen"]
+
+            # filtering still applies: scored records never reach it
+            assert all(k == "measurements"
+                       for _, k in conn.api.state["seen"])
+
+    run(main())
+
+
+def test_scripted_connector_republish(run):
+    """Scripts can bridge records onto custom bus topics (the Groovy
+    connector's 'forward to anything' role)."""
+    async def main():
+        sections = {"outbound-connectors": {
+            "scripts": {"fwd": CONNECTOR_REPUBLISH},
+            "connectors": [{"kind": "script", "name": "bridge",
+                            "script": "fwd",
+                            "kinds": ["measurements"]}]}}
+        async with full_instance(sections) as rt:
+            consumer = rt.bus.subscribe("custom.sink.acme", group="t")
+            try:
+                tick = _ingest_measurements(rt)
+                await tick(1000.0)
+                got = []
+                for _ in range(50):
+                    got += [r.value for r in
+                            await consumer.poll(max_records=8,
+                                                timeout=0.1)]
+                    if got:
+                        break
+                assert got and got[0]["kind"] == "measurements"
+            finally:
+                consumer.close()
+
+    run(main())
+
+
+def test_connector_script_guards(run):
+    """Unknown script refused at config; delete refused while in use."""
+    async def main():
+        sections = {"outbound-connectors": {
+            "scripts": {"used": CONNECTOR_V1},
+            "connectors": [{"kind": "script", "name": "sc",
+                            "script": "used"}]}}
+        async with full_instance(sections) as rt:
+            out = rt.api("outbound-connectors").engine("acme")
+            try:
+                out.add_connector_config({"kind": "script", "name": "x",
+                                          "script": "nope"})
+                raise AssertionError("unknown script accepted")
+            except ValueError:
+                pass
+            try:
+                out.delete_connector_script("used")
+                raise AssertionError("in-use delete accepted")
+            except ValueError as exc:
+                assert "sc" in str(exc)
+            out.remove_connector("sc")
+            out.delete_connector_script("used")  # now fine
+
+    run(main())
+
+
+def test_scripted_encoder_roundtrip_and_hot_swap(run):
+    """A scripted encoder drives a REAL delivery round trip (invocation
+    → encode → queue provider inbox); upload mid-stream re-frames the
+    next delivery."""
+    async def main():
+        sections = {"command-delivery": {
+            "scripts": {"csv": ENCODER_V1},
+            "routes": {"thermo": {"encoder": "script:csv",
+                                  "provider": "queue"}}}}
+        async with full_instance(sections) as rt:
+            dm = rt.api("device-management").management("acme")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="reboot", device_type_id=dt.id, name="reboot"))
+            device = dm.get_device_by_token("dev-3")
+            assignment = dm.get_active_assignments_for_device(device.id)[0]
+            em = rt.api("event-management").management("acme")
+            delivery = rt.api("command-delivery").delivery("acme")
+            provider = delivery.providers["queue"]
+
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id)])
+            await wait_until(lambda: provider.inbox("dev-3"))
+            assert provider.inbox("dev-3")[0] == b"CSV1,dev-3,reboot"
+
+            delivery.put_encoder_script("csv", ENCODER_V2)
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id)])
+            await wait_until(lambda: len(provider.inbox("dev-3")) >= 2)
+            assert provider.inbox("dev-3")[1] == b"CSV2,dev-3,reboot"
+
+    run(main())
+
+
+def test_encoder_script_guards(run):
+    """Routed encoder scripts can't be deleted; unknown script fails the
+    route resolution loudly."""
+    async def main():
+        sections = {"command-delivery": {
+            "scripts": {"csv": ENCODER_V1},
+            "routes": {"thermo": {"encoder": "script:csv"}}}}
+        async with full_instance(sections) as rt:
+            delivery = rt.api("command-delivery").delivery("acme")
+            try:
+                delivery.delete_encoder_script("csv")
+                raise AssertionError("routed delete accepted")
+            except ValueError as exc:
+                assert "thermo" in str(exc)
+            try:
+                delivery._resolve_encoder("script:ghost")
+                raise AssertionError("unknown script resolved")
+            except KeyError:
+                pass
+            del delivery.routes["thermo"]
+            delivery.delete_encoder_script("csv")
+
+    run(main())
+
+
+def test_rest_connector_and_encoder_script_crud(run):
+    """REST CRUD for both new script families + dynamic connector
+    attach/detach (mirrors the receiver surface)."""
+    from tests.test_rest import http, rest_instance
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            tok = body["token"]
+            await http(port, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme",
+                             "sections": {"rule-processing":
+                                          {"model": None}}})
+
+            # connector scripts
+            status, body = await http(
+                port, "PUT", "/api/connector-scripts/fwd", token=tok,
+                tenant="acme", body={"source": CONNECTOR_V1})
+            assert status == 200 and body["version"] == 1
+            status, body = await http(
+                port, "PUT", "/api/connector-scripts/bad", token=tok,
+                tenant="acme",
+                body={"source": "def sink(r, a): pass"})  # not async
+            assert status == 400
+            status, scripts = await http(
+                port, "GET", "/api/connector-scripts", token=tok,
+                tenant="acme")
+            assert status == 200 and scripts[0]["name"] == "fwd"
+
+            # attach a scripted connector, delete-in-use refused,
+            # detach, delete ok
+            status, body = await http(
+                port, "POST", "/api/connectors", token=tok,
+                tenant="acme",
+                body={"kind": "script", "name": "sc", "script": "fwd"})
+            assert status == 200, body
+            status, conns = await http(port, "GET", "/api/connectors",
+                                       token=tok, tenant="acme")
+            assert status == 200 and conns[-1]["script"] == "fwd"
+            status, body = await http(
+                port, "DELETE", "/api/connector-scripts/fwd", token=tok,
+                tenant="acme")
+            assert status == 409
+            status, body = await http(
+                port, "DELETE", "/api/connectors/sc", token=tok,
+                tenant="acme")
+            assert status == 200
+            status, body = await http(
+                port, "DELETE", "/api/connector-scripts/fwd", token=tok,
+                tenant="acme")
+            assert status == 200
+
+            # encoder scripts
+            status, body = await http(
+                port, "PUT", "/api/encoder-scripts/csv", token=tok,
+                tenant="acme", body={"source": ENCODER_V1})
+            assert status == 200 and body["version"] == 1
+            status, scripts = await http(
+                port, "GET", "/api/encoder-scripts", token=tok,
+                tenant="acme")
+            assert status == 200 and scripts[0]["name"] == "csv"
+            status, body = await http(
+                port, "DELETE", "/api/encoder-scripts/csv", token=tok,
+                tenant="acme")
+            assert status == 200
+
+    run(main())
