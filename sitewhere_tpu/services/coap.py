@@ -36,9 +36,11 @@ CODE_EMPTY = 0x00
 CODE_POST = 0x02
 CODE_CHANGED = 0x44        # 2.04
 CODE_BAD_REQUEST = 0x80    # 4.00
+CODE_UNAUTHORIZED = 0x81   # 4.01
 CODE_NOT_FOUND = 0x84      # 4.04
 CODE_NOT_ALLOWED = 0x85    # 4.05
 OPT_URI_PATH = 11
+OPT_URI_QUERY = 15
 
 # CON dedup horizon (RFC 7252 EXCHANGE_LIFETIME is 247 s; constrained
 # retransmit windows are far shorter — 64 s covers MAX_TRANSMIT_SPAN)
@@ -108,12 +110,23 @@ class CoapListener(asyncio.DatagramProtocol):
     task) for every accepted POST."""
 
     def __init__(self, on_payload, host: str = "127.0.0.1", port: int = 0,
-                 path: str = "telemetry"):
+                 path: str = "telemetry", secret: Optional[str] = None):
         self.on_payload = on_payload
         self.host, self.port = host, port
         self.path = path
+        # shared-secret ingest auth: when set, POSTs must carry a
+        # Uri-Query option `token=<secret>` or they get 4.01 and are
+        # never decoded. DEPLOYMENT CAVEAT: CoAP here is cleartext UDP
+        # (no DTLS in this build) — the token rides unencrypted, so it
+        # gates misdirected/unsophisticated traffic, not an on-path
+        # attacker; treat the transport like the reference treats plain
+        # MQTT and run it on trusted networks. The comparison is
+        # constant-time (hmac.compare_digest) so the gate itself leaks
+        # nothing via timing.
+        self.secret = secret
         self.malformed = 0
         self.accepted = 0
+        self.unauthorized = 0
         self._transport: Optional[asyncio.DatagramTransport] = None
         # processing tasks are retained until done: the loop holds tasks
         # only weakly, and a GC'd pending task would drop an ACKed
@@ -158,6 +171,16 @@ class CoapListener(asyncio.DatagramProtocol):
         self._seen[(addr, mid)] = (time.monotonic() + DEDUP_SECONDS, data)
         self._reply(addr, data)
 
+    def _authorized(self, options) -> bool:
+        import hmac
+
+        want = self.secret.encode()
+        for n, v in options:
+            if n == OPT_URI_QUERY and v.startswith(b"token="):
+                if hmac.compare_digest(v[len(b"token="):], want):
+                    return True
+        return False
+
     def datagram_received(self, data: bytes, addr) -> None:
         try:
             mtype, code, mid, token, options, payload = parse_message(data)
@@ -189,6 +212,12 @@ class CoapListener(asyncio.DatagramProtocol):
             if mtype == TYPE_CON:
                 self._reply_con(addr, mid, build_message(
                     TYPE_ACK, CODE_NOT_FOUND, mid, token))
+            return
+        if self.secret is not None and not self._authorized(options):
+            self.unauthorized += 1
+            if mtype == TYPE_CON:
+                self._reply_con(addr, mid, build_message(
+                    TYPE_ACK, CODE_UNAUTHORIZED, mid, token))
             return
         if not payload:
             if mtype == TYPE_CON:
@@ -239,7 +268,8 @@ def _encode_option(number_delta: int, value: bytes) -> bytes:
 
 
 def build_request(code: int, mid: int, token: bytes, path: str,
-                  payload: bytes, mtype: int = TYPE_CON) -> bytes:
+                  payload: bytes, mtype: int = TYPE_CON,
+                  query: Optional[str] = None) -> bytes:
     out = bytearray([(1 << 6) | (mtype << 4) | len(token), code])
     out += mid.to_bytes(2, "big")
     out += token
@@ -247,6 +277,9 @@ def build_request(code: int, mid: int, token: bytes, path: str,
     for seg in path.split("/"):
         out += _encode_option(OPT_URI_PATH - number, seg.encode())
         number = OPT_URI_PATH
+    if query:
+        out += _encode_option(OPT_URI_QUERY - number, query.encode())
+        number = OPT_URI_QUERY
     if payload:
         out += b"\xff" + payload
     return bytes(out)
@@ -265,7 +298,8 @@ _mid_counter = [0]
 
 async def coap_post(host: str, port: int, path: str, payload: bytes,
                     ack_timeout: float = 2.0, max_retransmit: int = 4,
-                    confirmable: bool = True) -> int:
+                    confirmable: bool = True,
+                    secret: Optional[str] = None) -> int:
     """POST `payload` to coap://host:port/<path>; returns the response
     code (e.g. 0x44 = 2.04). CON requests retransmit with exponential
     backoff per §4.2 (ACK_TIMEOUT doubling, MAX_RETRANSMIT attempts);
@@ -279,7 +313,8 @@ async def coap_post(host: str, port: int, path: str, payload: bytes,
         mid = _mid_counter[0]
         token = mid.to_bytes(2, "big")
         msg = build_request(CODE_POST, mid, token, path, payload,
-                            mtype=TYPE_CON if confirmable else TYPE_NON)
+                            mtype=TYPE_CON if confirmable else TYPE_NON,
+                            query=f"token={secret}" if secret is not None else None)
         if not confirmable:
             transport.sendto(msg)
             return CODE_EMPTY

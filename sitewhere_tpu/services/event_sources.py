@@ -395,14 +395,15 @@ class CoapEventReceiver(BackgroundTaskComponent):
 
     def __init__(self, name: str, engine: "EventSourcesEngine",
                  decoder: EventDecoder, host: str = "127.0.0.1",
-                 port: int = 0, path: str = "telemetry"):
+                 port: int = 0, path: str = "telemetry",
+                 secret: Optional[str] = None):
         super().__init__(name)
         self.engine = engine
         self.decoder = decoder
         from sitewhere_tpu.services.coap import CoapListener
 
         self.listener = CoapListener(self._on_payload, host=host, port=port,
-                                     path=path)
+                                     path=path, secret=secret)
 
     @property
     def port(self) -> int:
@@ -607,7 +608,8 @@ class EventSourcesEngine(TenantEngine):
             r = CoapEventReceiver(name, self, decoder,
                                   host=cfg.get("host", "127.0.0.1"),
                                   port=cfg.get("port", 0),
-                                  path=cfg.get("path", "telemetry"))
+                                  path=cfg.get("path", "telemetry"),
+                                  secret=cfg.get("secret"))
         elif kind == "amqp":
             r = AmqpEventReceiver(name, self, decoder,
                                   host=cfg.get("host", "127.0.0.1"),

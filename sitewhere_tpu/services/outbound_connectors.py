@@ -375,6 +375,11 @@ class OutboundConnectorsEngine(TenantEngine):
                           min_score=c.get("min_score"))
         kind = c.get("kind", "memory")
         name = c.get("name")
+        if name and name in self.connectors:
+            # a silent replace would orphan the old connector's
+            # resources and lose its config — refuse at every call
+            # site, not just the REST pre-check
+            raise ValueError(f"connector {name!r} already exists")
         if not name:  # generated names must never collide/replace
             i = len(self.connectors)
             while f"{kind}-{i}" in self.connectors:

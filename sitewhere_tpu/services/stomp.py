@@ -84,6 +84,9 @@ class StompListener:
         self.authenticate = authenticate
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set[asyncio.StreamWriter] = set()
+        # protocol-violation drops (hostile/broken peers) — the fuzz
+        # suite's observability hook, mirrors CoapListener.malformed
+        self.malformed = 0
 
     async def start(self) -> None:
         # stream limit covers a whole NUL-scanned body (the default
@@ -208,10 +211,13 @@ class StompListener:
                     await self._send(writer, "ERROR",
                                      {"message": f"unsupported {command}"})
                     return
-        except (asyncio.IncompleteReadError, ConnectionResetError,
+        except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.LimitOverrunError):
+            # benign disconnects (incl. BrokenPipeError writing a
+            # RECEIPT to a just-closed peer) — NOT protocol violations
             pass
         except Exception as exc:  # noqa: BLE001 - one peer can't kill the endpoint
+            self.malformed += 1
             logger.info("stomp: dropping connection: %s", exc)
         finally:
             self._writers.discard(writer)

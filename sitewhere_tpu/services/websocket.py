@@ -75,6 +75,9 @@ class WebSocketListener:
         self.sessions: dict[str, WsSession] = {}
         self._conns: set[asyncio.StreamWriter] = set()
         self._server: Optional[asyncio.AbstractServer] = None
+        # protocol-violation drops (hostile/broken peers) — the fuzz
+        # suite's observability hook, mirrors CoapListener.malformed
+        self.malformed = 0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host,
@@ -175,21 +178,33 @@ class WebSocketListener:
         return client_id
 
     async def _read_frame(self, reader) -> tuple[int, bool, bytes]:
+        """RFC 6455 §5.2-strict: nonzero RSV (no extension negotiated),
+        reserved opcodes, unmasked client frames, and fragmented or
+        >125-byte control frames are protocol errors — hostile input,
+        fail the connection rather than guess."""
         b1, b2 = await reader.readexactly(2)
         fin = bool(b1 & 0x80)
+        if b1 & 0x70:
+            raise ValueError("nonzero RSV bits without an extension")
         opcode = b1 & 0x0F
+        if opcode not in (OP_CONT, OP_TEXT, OP_BINARY,
+                          OP_CLOSE, OP_PING, OP_PONG):
+            raise ValueError(f"reserved opcode {opcode:#x}")
         masked = bool(b2 & 0x80)
+        if not masked:
+            raise ValueError("client frame not masked")
         length = b2 & 0x7F
+        if opcode >= OP_CLOSE and (not fin or length > 125):
+            raise ValueError("fragmented or oversized control frame")
         if length == 126:
             length = int.from_bytes(await reader.readexactly(2), "big")
         elif length == 127:
             length = int.from_bytes(await reader.readexactly(8), "big")
         if length > MAX_MESSAGE:
             raise ValueError(f"ws frame {length} exceeds max")
-        mask = await reader.readexactly(4) if masked else None
+        mask = await reader.readexactly(4)
         payload = await reader.readexactly(length) if length else b""
-        if mask:
-            payload = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        payload = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
         return opcode, fin, payload
 
     async def _handle(self, reader: asyncio.StreamReader,
@@ -202,6 +217,7 @@ class WebSocketListener:
                 return
             session = self.sessions[client_id]  # reserved in _handshake
             buffer = bytearray()
+            fragmented = False
             while True:
                 opcode, fin, payload = await self._read_frame(reader)
                 if opcode == OP_CLOSE:
@@ -214,14 +230,26 @@ class WebSocketListener:
                     continue
                 if opcode == OP_PONG:
                     continue
+                # §5.4 fragmentation state machine: a new data frame
+                # mid-message or a stray continuation is a protocol error
+                if opcode == OP_CONT:
+                    if not fragmented:
+                        raise ValueError("continuation without a message")
+                elif fragmented:
+                    raise ValueError("data frame inside fragmented message")
                 buffer += payload
                 if len(buffer) > MAX_MESSAGE:
                     raise ValueError("ws message exceeds max")
+                fragmented = not fin
                 if fin:
                     message = bytes(buffer)
                     buffer.clear()
                     await self.on_message(message, client_id)
-        except (asyncio.IncompleteReadError, ConnectionError, ValueError,
+        except ValueError as exc:
+            self.malformed += 1
+            logger.info("ws: protocol violation, dropping %s: %s",
+                        session.client_id if session else "?", exc)
+        except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.TimeoutError, IndexError):
             pass
         finally:

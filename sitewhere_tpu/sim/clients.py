@@ -118,9 +118,11 @@ class CoapSender:
 
     MAX_PAYLOAD = 60_000    # one UDP datagram (65,507 B) minus headroom
 
-    def __init__(self, host: str, port: int, path: str = "telemetry"):
+    def __init__(self, host: str, port: int, path: str = "telemetry",
+                 secret: Optional[str] = None):
         self.host, self.port = host, port
         self.path = path
+        self.secret = secret
         self._transport = None
         self._mid = 0
         self._error: Optional[Exception] = None
@@ -152,7 +154,8 @@ class CoapSender:
         self._mid = (self._mid + 1) % 0x10000
         self._transport.sendto(build_request(
             CODE_POST, self._mid, self._mid.to_bytes(2, "big"),
-            self.path, payload, mtype=TYPE_NON))
+            self.path, payload, mtype=TYPE_NON,
+            query=f"token={self.secret}" if self.secret is not None else None))
 
     async def close(self) -> None:
         if self._transport is not None:

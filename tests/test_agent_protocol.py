@@ -1157,3 +1157,65 @@ def test_stomp_fuzz_and_unsupported_frames(run):
             await listener.stop()
 
     run(main())
+
+
+def test_coap_shared_secret_auth(run):
+    """With a listener secret set, POSTs carrying the Uri-Query
+    `token=<secret>` are ingested; wrong/missing tokens get 4.01 and are
+    never decoded (counted in `unauthorized`). CoAP here is cleartext
+    UDP — the secret gates misdirected traffic, not an on-path attacker
+    (documented deployment caveat, services/coap.py)."""
+
+    async def main():
+        from sitewhere_tpu.services.coap import (
+            CODE_CHANGED,
+            CODE_UNAUTHORIZED,
+            CoapListener,
+            coap_post,
+        )
+
+        got = []
+
+        async def on_payload(payload, source):
+            got.append(payload)
+
+        listener = CoapListener(on_payload, path="telemetry",
+                                secret="s3cr3t")
+        await listener.start()
+        try:
+            # right token → 2.04, payload ingested
+            code = await coap_post("127.0.0.1", listener.port,
+                                   "telemetry", b"authed-payload",
+                                   secret="s3cr3t")
+            assert code == CODE_CHANGED
+            await wait_until(lambda: got == [b"authed-payload"])
+            # wrong token → 4.01, nothing ingested
+            code = await coap_post("127.0.0.1", listener.port,
+                                   "telemetry", b"intruder",
+                                   secret="wrong")
+            assert code == CODE_UNAUTHORIZED
+            # missing token → 4.01
+            code = await coap_post("127.0.0.1", listener.port,
+                                   "telemetry", b"anonymous")
+            assert code == CODE_UNAUTHORIZED
+            await asyncio.sleep(0.1)
+            assert got == [b"authed-payload"]
+            assert listener.unauthorized == 2
+            # NON without a token is silently dropped (nothing to ACK)
+            from sitewhere_tpu.sim.clients import CoapSender
+
+            s = CoapSender("127.0.0.1", listener.port)
+            await s.connect()
+            await s.send(b"non-anon")
+            await s.close()
+            s2 = CoapSender("127.0.0.1", listener.port, secret="s3cr3t")
+            await s2.connect()
+            await s2.send(b"non-authed")
+            await s2.close()
+            await wait_until(
+                lambda: got == [b"authed-payload", b"non-authed"])
+            assert listener.unauthorized == 3
+        finally:
+            await listener.stop()
+
+    run(main())

@@ -1,0 +1,208 @@
+"""Deep fuzz for the hostile-input TCP parsers: STOMP 1.2 + WebSocket.
+
+Completes the protocol fuzz matrix ([SURVEY.md §4] adversarial-input
+rows; AMQP framing and CoAP datagrams are covered in
+test_agent_protocol.py): ≥10k random/mutated frames per endpoint, the
+listener survives (no hang, no unhandled exception, fresh valid
+sessions still work), and the `malformed` counters record the drops.
+"""
+
+import asyncio
+
+import numpy as np
+
+from sitewhere_tpu.services.stomp import StompListener
+from sitewhere_tpu.services.websocket import WebSocketListener
+
+from tests.test_agent_protocol import (
+    _stomp_read_frame,
+    _ws_client_frame,
+    _ws_connect,
+    _ws_read_frame,
+)
+from tests.test_pipeline import wait_until
+
+
+# ---------------------------------------------------------------------------
+# STOMP
+# ---------------------------------------------------------------------------
+
+def _stomp_mutations(rng) -> list[bytes]:
+    """One batch of hostile SEND-frame mutations (each may kill its
+    connection; the server must only ever kill THAT connection)."""
+    body = bytes(rng.integers(0, 256, int(rng.integers(0, 64)),
+                              dtype=np.uint8))
+    muts = [
+        # bad header escape sequences (\t and \x are not in the table)
+        b"SEND\ndestination:a\\tb\n\nx\x00",
+        b"SEND\ndest\\xination:a\n\nx\x00",
+        # lone trailing backslash in a header value
+        b"SEND\ndestination:trail\\\n\nx\x00",
+        # oversized headers: one 16 KiB header line (> MAX_HEADERS)
+        b"SEND\n" + b"h:" + b"A" * (16 * 1024) + b"\n\nx\x00",
+        # many headers adding past the bound
+        b"SEND\n" + b"".join(b"k%d:v\n" % i for i in range(4000)) +
+        b"\nx\x00",
+        # content-length lies: shorter than the body (terminator check
+        # must fire on the non-NUL byte)
+        b"SEND\ndestination:d\ncontent-length:2\n\nlonger-body\x00",
+        # content-length absurdly large (> MAX_FRAME bound, refused
+        # before any read)
+        b"SEND\ndestination:d\ncontent-length:999999999999\n\nx\x00",
+        # content-length not a number
+        b"SEND\ndestination:d\ncontent-length:NaN\n\nx\x00",
+        # NUL placement: inside headers / before blank line / doubled
+        b"SEND\ndest\x00ination:d\n\nx\x00",
+        b"SEND\ndestination:d\x00\n\nx\x00",
+        b"SEND\ndestination:d\n\n\x00\x00",
+        # header-line injection through an encoded value is NOT an
+        # error (escapes decode to data) — mixed in as a legal frame
+        b"SEND\ndestination:a\\nb\n\nx\x00",
+        # random garbage
+        bytes(rng.integers(0, 256, int(rng.integers(1, 128)),
+                           dtype=np.uint8)),
+        # truncated valid frame
+        (b"SEND\ndestination:d\ncontent-length:%d\n\n" % (len(body) + 40))
+        + body,
+    ]
+    rng.shuffle(muts)
+    return muts
+
+
+def test_stomp_deep_fuzz_survives_10k_frames(run):
+    async def main():
+        got = []
+
+        async def on_message(dest, body, source):
+            got.append((dest, body))
+
+        listener = StompListener(on_message)
+        await listener.start()
+        try:
+            rng = np.random.default_rng(1205)
+            sent = 0
+            conns = 0
+            while sent < 10_000:
+                # one connection: CONNECT, a few valid SENDs, then a
+                # burst of mutations written together (the server parses
+                # until the first violation and must drop ONLY this
+                # connection)
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", listener.port)
+                conns += 1
+                writer.write(b"CONNECT\naccept-version:1.2\n\n\x00")
+                burst = _stomp_mutations(rng)
+                writer.write(b"SEND\ndestination:ok\n\nvalid\x00")
+                for m in burst:
+                    writer.write(m)
+                sent += len(burst) + 1
+                try:
+                    await asyncio.wait_for(writer.drain(), 5.0)
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass
+                writer.close()
+            assert conns >= 500  # the 10k really were spread out
+            # endpoint alive: a fresh, strictly-valid session round-trips
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", listener.port)
+            writer.write(b"CONNECT\naccept-version:1.2\n\n\x00")
+            cmd, _, _ = await asyncio.wait_for(_stomp_read_frame(reader),
+                                               5.0)
+            assert cmd == "CONNECTED"
+            writer.write(b"SEND\ndestination:final\nreceipt:r1\n\n"
+                         b"alive\x00")
+            cmd, headers, _ = await asyncio.wait_for(
+                _stomp_read_frame(reader), 5.0)
+            assert cmd == "RECEIPT" and headers["receipt-id"] == "r1"
+            await wait_until(lambda: ("final", b"alive") in got,
+                             timeout=5.0)
+            writer.close()
+            assert listener.malformed > 0
+            # the legal frames interleaved with the killers landed
+            assert any(d == "ok" for d, _ in got)
+        finally:
+            await listener.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# WebSocket
+# ---------------------------------------------------------------------------
+
+def _ws_mutations(rng) -> list[bytes]:
+    data = bytes(rng.integers(0, 256, int(rng.integers(0, 64)),
+                              dtype=np.uint8))
+    rsv_frame = bytearray(_ws_client_frame(b"x"))
+    rsv_frame[0] |= 0x40                      # RSV1 without extension
+    unmasked = bytearray(_ws_client_frame(b"y"))
+    unmasked[1] &= 0x7F                       # clear MASK bit
+    muts = [
+        bytes(rsv_frame),
+        bytes(unmasked),
+        _ws_client_frame(data, opcode=0x3),   # reserved opcode
+        _ws_client_frame(data, opcode=0xF),
+        _ws_client_frame(b"ping", opcode=0x9, fin=False),  # fragmented ctl
+        _ws_client_frame(b"p" * 200, opcode=0x9),          # >125 control
+        _ws_client_frame(data, opcode=0x0),   # stray continuation
+        # data frame inside a fragmented message
+        _ws_client_frame(b"part", opcode=0x2, fin=False)
+        + _ws_client_frame(b"new", opcode=0x2, fin=True),
+        # 64-bit length lie far beyond MAX_MESSAGE
+        bytes([0x82, 0xFF]) + (1 << 60).to_bytes(8, "big")
+        + bytes(4) + b"tiny",
+        # random garbage
+        bytes(rng.integers(0, 256, int(rng.integers(2, 64)),
+                           dtype=np.uint8)),
+    ]
+    rng.shuffle(muts)
+    return muts
+
+
+def test_websocket_deep_fuzz_survives_10k_frames(run):
+    async def main():
+        got = []
+
+        async def on_message(payload, client_id):
+            got.append(payload)
+
+        listener = WebSocketListener(on_message)
+        await listener.start()
+        try:
+            rng = np.random.default_rng(64)
+            sent = 0
+            conns = 0
+            while sent < 10_000:
+                reader, writer = await _ws_connect(
+                    listener.port, f"/ws/fuzz-{conns}")
+                conns += 1
+                writer.write(_ws_client_frame(b"valid-first"))
+                burst = []
+                for _ in range(5):
+                    burst += _ws_mutations(rng)
+                for m in burst:
+                    writer.write(m)
+                sent += len(burst) + 1
+                try:
+                    await asyncio.wait_for(writer.drain(), 5.0)
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass
+                writer.close()
+            assert conns >= 100
+            # endpoint alive: fresh valid session, incl. a legal
+            # fragmented message and an interleaved ping
+            reader, writer = await _ws_connect(listener.port, "/ws/final")
+            writer.write(_ws_client_frame(b"he", fin=False))
+            writer.write(_ws_client_frame(b"pp", opcode=0x9))  # ping ok
+            op, payload = await asyncio.wait_for(_ws_read_frame(reader),
+                                                 5.0)
+            assert op == 0xA and payload == b"pp"
+            writer.write(_ws_client_frame(b"llo", opcode=0x0, fin=True))
+            await wait_until(lambda: b"hello" in got, timeout=5.0)
+            writer.close()
+            assert listener.malformed > 0
+            assert b"valid-first" in got
+        finally:
+            await listener.stop()
+
+    run(main())

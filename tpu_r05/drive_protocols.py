@@ -1,0 +1,101 @@
+"""Round-5 verify drive #4: CoAP secret + strict WS on hosted receivers."""
+import asyncio
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.model import DeviceType
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.services import (
+    DeviceManagementService,
+    EventManagementService,
+    EventSourcesService,
+    InboundProcessingService,
+)
+from sitewhere_tpu.sim import DeviceSimulator, SimConfig
+from sitewhere_tpu.sim.clients import CoapSender, WebSocketSender
+
+
+async def main():
+    rt = ServiceRuntime(InstanceSettings(instance_id="drive4"))
+    for cls in (DeviceManagementService, EventSourcesService,
+                InboundProcessingService, EventManagementService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    await rt.add_tenant(TenantConfig(tenant_id="acme", sections={
+        "event-sources": {"receivers": [
+            {"kind": "coap", "decoder": "swb1", "name": "co",
+             "port": 47841, "secret": "hunter2"},
+            {"kind": "websocket", "decoder": "swb1", "name": "ws",
+             "port": 47842},
+        ]}}))
+    rt.api("device-management").management("acme").bootstrap_fleet(
+        DeviceType(token="thermo"), 64)
+    em = rt.api("event-management").management("acme")
+    sim = DeviceSimulator(SimConfig(num_devices=64), tenant_id="acme")
+
+    # CoAP: wrong secret rejected, right secret ingested
+    bad = CoapSender("127.0.0.1", 47841, secret="wrong")
+    await bad.connect()
+    batch, _ = sim.tick(t=100.0)
+    await bad.send(batch.encode())
+    await bad.close()
+    await asyncio.sleep(0.3)
+    assert em.telemetry.total_events == 0, em.telemetry.total_events
+    good = CoapSender("127.0.0.1", 47841, secret="hunter2")
+    await good.connect()
+    batch, _ = sim.tick(t=101.0)
+    await good.send(batch.encode())
+    await good.close()
+    for _ in range(50):
+        if em.telemetry.total_events == 64:
+            break
+        await asyncio.sleep(0.1)
+    assert em.telemetry.total_events == 64, em.telemetry.total_events
+    listener = (rt.api("event-sources").engine("acme")
+                .receiver("co").listener)
+    assert listener.unauthorized == 1, listener.unauthorized
+
+    # WS: hostile frame (bad RSV) drops that conn + counts; a fresh
+    # valid sender still ingests
+    r, w = await asyncio.open_connection("127.0.0.1", 47842)
+    import base64, hashlib, os as _os
+    key = base64.b64encode(_os.urandom(16)).decode()
+    w.write((f"GET /ws/evil HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+             f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+             f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    await w.drain()
+    await r.readuntil(b"\r\n\r\n")
+    w.write(bytes([0xC2, 0x81, 1, 2, 3, 4, 0x55]))  # RSV1 set
+    await w.drain()
+    w.close()
+    ws_listener = (rt.api("event-sources").engine("acme")
+                   .receiver("ws").listener)
+    for _ in range(50):
+        if ws_listener.malformed >= 1:
+            break
+        await asyncio.sleep(0.1)
+    assert ws_listener.malformed == 1, ws_listener.malformed
+    sender = WebSocketSender("127.0.0.1", 47842, client_id="dev-1")
+    await sender.connect()
+    batch, _ = sim.tick(t=102.0)
+    await sender.send(batch.encode())
+    await sender.close()
+    for _ in range(50):
+        if em.telemetry.total_events == 128:
+            break
+        await asyncio.sleep(0.1)
+    assert em.telemetry.total_events == 128, em.telemetry.total_events
+
+    await rt.stop()
+    print("VERIFY-PROTOCOLS-OK")
+
+
+asyncio.run(main())
