@@ -227,6 +227,12 @@ async def cmd_run(args) -> int:
     for tenant in tenants:
         if "instance-management" in rt.services:
             im = rt.services["instance-management"]
+            if im.tenant_store.get_tenant_by_token(
+                    tenant.tenant_id) is not None:
+                # durable restart (SWX_DATA_DIR): the tenant was
+                # restored from the snapshot and is respinning — the
+                # boot-time bootstrap must be idempotent, not fatal
+                continue
             await im.create_tenant(tenant.tenant_id, tenant.name,
                                    dict(tenant.sections),
                                    tuple(tenant.authorized_user_ids))

@@ -208,6 +208,10 @@ class ServiceRuntime(LifecycleComponent):
         self.services: dict[str, Service] = {}
         self.remotes: dict[str, Any] = {}   # identifier -> RemoteService
         self.tenants: dict[str, TenantConfig] = {}
+        # monotonic change counter over the tenant-config map — the
+        # instance snapshotter's debounce epoch (a size-based epoch
+        # aliases: delete bumps a counter while the size drops)
+        self.tenant_epoch = 0
 
     # -- wiring ------------------------------------------------------------
 
@@ -277,6 +281,7 @@ class ServiceRuntime(LifecycleComponent):
     async def add_tenant(self, tenant: TenantConfig, *, timeout: float = 60.0) -> None:
         """Register a tenant and broadcast creation (reference: §3.5)."""
         self.tenants[tenant.tenant_id] = tenant
+        self.tenant_epoch += 1
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "created", "tenant": tenant}, key=tenant.tenant_id)
@@ -284,6 +289,7 @@ class ServiceRuntime(LifecycleComponent):
 
     async def update_tenant(self, tenant: TenantConfig) -> None:
         self.tenants[tenant.tenant_id] = tenant
+        self.tenant_epoch += 1
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "updated", "tenant": tenant}, key=tenant.tenant_id)
@@ -293,6 +299,7 @@ class ServiceRuntime(LifecycleComponent):
         tenant = self.tenants.pop(tenant_id, None)
         if tenant is None:
             return
+        self.tenant_epoch += 1
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "deleted", "tenant": tenant}, key=tenant_id)

@@ -98,7 +98,33 @@ class _EntityTable:
         return sorted(self.by_id.values(), key=lambda e: e.created_date)
 
 
-class InMemoryDeviceManagement:
+class _TableSnapshotMixin:
+    """Durability contract shared by the entity stores: `_TABLES` names
+    the `_EntityTable` attributes snapshotted/restored as a unit, and
+    `mutations` is the debounce epoch (persistence/durable.py snapshots
+    via services/snapshot.StoreSnapshotter). Restore merges by id and
+    rebuilds token indexes; subclasses extend for derived state."""
+
+    _TABLES: tuple = ()
+    mutations: int = 0
+
+    def _bump_mutations(self) -> None:
+        self.mutations += 1
+
+    def to_snapshot(self) -> dict:
+        return {"tables": {name: list(getattr(self, name).by_id.values())
+                           for name in self._TABLES}}
+
+    def restore_snapshot(self, snap: dict) -> None:
+        for name in self._TABLES:
+            table = getattr(self, name)
+            for entity in snap["tables"].get(name, []):
+                table.by_id[entity.id] = entity
+                if getattr(entity, "token", ""):
+                    table.by_token[entity.token] = entity.id
+
+
+class InMemoryDeviceManagement(_TableSnapshotMixin):
     """Implements DeviceManagementSPI for one tenant.
 
     TPU-first detail: devices get dense indices from a monotonically
@@ -112,9 +138,8 @@ class InMemoryDeviceManagement:
                "assignments", "groups", "customers", "areas", "zones")
 
     def __init__(self) -> None:
-        # mutation epoch: bumped on every entity write/delete — the
-        # snapshotter's "anything changed since last save?" signal
-        self.mutations = 0
+        # mutation epoch (mixin): bumped on every entity write/delete —
+        # the snapshotter's "anything changed since last save?" signal
         bump = self._bump_mutations
         self.device_types = _EntityTable(bump)
         self.commands = _EntityTable(bump)
@@ -131,20 +156,15 @@ class InMemoryDeviceManagement:
         self._index_to_device_id: dict[int, str] = {}
         self._active_assignment_by_device: dict[str, list[str]] = {}
 
-    def _bump_mutations(self) -> None:
-        self.mutations += 1
-
     # -- durability (persistence/durable.py snapshots) ---------------------
 
     def to_snapshot(self) -> dict:
         """Whole-store state as codec-serializable primitives + entities."""
-        return {
-            "tables": {name: list(getattr(self, name).by_id.values())
-                       for name in self._TABLES},
-            "group_elements": {gid: list(els) for gid, els
-                               in self.group_elements.items()},
-            "next_index": self._next_index,
-        }
+        snap = super().to_snapshot()
+        snap["group_elements"] = {gid: list(els) for gid, els
+                                  in self.group_elements.items()}
+        snap["next_index"] = self._next_index
+        return snap
 
     def restore_snapshot(self, snap: dict) -> None:
         """Rebuild every table and derived index from `to_snapshot()`
@@ -155,12 +175,7 @@ class InMemoryDeviceManagement:
         self._token_to_index = {}
         self._index_to_device_id = {}
         self._active_assignment_by_device = {}
-        for name in self._TABLES:
-            table = getattr(self, name)
-            for entity in snap["tables"].get(name, []):
-                table.by_id[entity.id] = entity
-                if getattr(entity, "token", ""):
-                    table.by_token[entity.token] = entity.id
+        super().restore_snapshot(snap)
         self.group_elements = {gid: list(els) for gid, els
                                in snap.get("group_elements", {}).items()}
         self._next_index = int(snap.get("next_index", 0))
@@ -638,10 +653,12 @@ class InMemoryDeviceEventManagement:
         return self._filter_cold(self.state_changes, device_index, limit)
 
 
-class InMemoryAssetManagement:
+class InMemoryAssetManagement(_TableSnapshotMixin):
+    _TABLES = ("asset_types", "assets")
+
     def __init__(self) -> None:
-        self.asset_types = _EntityTable()
-        self.assets = _EntityTable()
+        self.asset_types = _EntityTable(self._bump_mutations)
+        self.assets = _EntityTable(self._bump_mutations)
 
     def create_asset_type(self, at: AssetType) -> AssetType:
         return self.asset_types.put(at)
@@ -679,12 +696,16 @@ class InMemoryAssetManagement:
         return _page(items, page, page_size)
 
 
-class InMemoryUserManagement:
+class InMemoryUserManagement(_TableSnapshotMixin):
     """Password hashing: salted PBKDF2 (stdlib; the reference uses Spring
-    Security encoders — capability, not algorithm, is the parity bar)."""
+    Security encoders — capability, not algorithm, is the parity bar).
+    Snapshots carry the salted hashes inside the User entities — never
+    plaintext."""
+
+    _TABLES = ("users",)
 
     def __init__(self) -> None:
-        self.users = _EntityTable()
+        self.users = _EntityTable(self._bump_mutations)
 
     @staticmethod
     def _hash(password: str, salt: bytes) -> str:
@@ -726,9 +747,11 @@ class InMemoryUserManagement:
         return self.users.values()
 
 
-class InMemoryTenantManagement:
+class InMemoryTenantManagement(_TableSnapshotMixin):
+    _TABLES = ("tenants",)
+
     def __init__(self) -> None:
-        self.tenants = _EntityTable()
+        self.tenants = _EntityTable(self._bump_mutations)
 
     def create_tenant(self, tenant: Tenant) -> Tenant:
         return self.tenants.put(tenant)

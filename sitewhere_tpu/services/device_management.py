@@ -38,10 +38,7 @@ class DeviceManagementEngine(TenantEngine):
         self.spi = InMemoryDeviceManagement()
         # dense boolean mask over device indices; grown on demand.
         self._registered = np.zeros(1024, dtype=bool)
-        self._snapshot_path: Optional[str] = None
-        import threading
-
-        self._snap_lock = threading.Lock()
+        self._snapshotter = None
 
     async def _do_initialize(self, monitor) -> None:
         cfg = self.tenant.section("device-management", {})
@@ -52,11 +49,12 @@ class DeviceManagementEngine(TenantEngine):
         import os
 
         from sitewhere_tpu.persistence.durable import load_snapshot
+        from sitewhere_tpu.services.snapshot import StoreSnapshotter
 
         tdir = os.path.join(data_dir, "tenants", self.tenant_id)
         os.makedirs(tdir, exist_ok=True)
-        self._snapshot_path = os.path.join(tdir, "registry.snap")
-        snap = load_snapshot(self._snapshot_path)
+        path = os.path.join(tdir, "registry.snap")
+        snap = load_snapshot(path)
         if snap is not None:
             self.spi.restore_snapshot(snap)
             # rebuild the hot-path mask from restored entities — status
@@ -67,30 +65,17 @@ class DeviceManagementEngine(TenantEngine):
                 self._registered[d.index] = d.status == "active"
             logger.info("device-management[%s]: restored %d devices from "
                         "snapshot", self.tenant_id, self.spi.device_count())
-        if not any(isinstance(c, _RegistrySnapshotter)
-                   for c in self._children):  # restart(): never two loops
-            self.add_child(_RegistrySnapshotter(
-                self, interval_s=cfg.get("snapshot_interval_s", 1.0)))
+        if self._snapshotter is None:  # restart(): never two loops
+            self._snapshotter = StoreSnapshotter(
+                "registry-snapshotter", path,
+                lambda: self.spi.mutations, self.spi.to_snapshot,
+                interval_s=cfg.get("snapshot_interval_s", 1.0))
+            self.add_child(self._snapshotter)
 
     async def _do_stop(self, monitor) -> None:
         await super()._do_stop(monitor)
-        self._save_snapshot()  # clean shutdown: nothing relies on the timer
-
-    def _save_snapshot(self) -> None:
-        if self._snapshot_path is None:
-            return
-        self._write_snapshot(self.spi.to_snapshot())
-
-    def _write_snapshot(self, snap: dict) -> None:
-        """Encode + atomic write. Lock-serialized: the snapshotter's
-        executor save can still be in flight when _do_stop's save runs
-        (task cancellation doesn't stop a worker thread), and two
-        writers interleaving on the same tmp path would install a
-        corrupt snapshot."""
-        from sitewhere_tpu.persistence.durable import save_snapshot
-
-        with self._snap_lock:
-            save_snapshot(self._snapshot_path, snap)
+        if self._snapshotter is not None:
+            self._snapshotter.save_now()  # clean shutdown loses nothing
 
     # -- hot path ----------------------------------------------------------
 
@@ -158,37 +143,6 @@ class DeviceManagementEngine(TenantEngine):
     def __getattr__(self, name):
         # non-overridden SPI surface passes straight through
         return getattr(self.spi, name)
-
-
-class _RegistrySnapshotter(BackgroundTaskComponent):
-    """Debounced registry persistence: every `interval_s`, write an
-    atomic snapshot iff the mutation epoch moved. Snapshot cost is a
-    codec encode of the whole registry — O(entities), off the hot path
-    (ingest never touches the registry; it reads the dense mask)."""
-
-    def __init__(self, engine: DeviceManagementEngine,
-                 interval_s: float = 1.0):
-        super().__init__("registry-snapshotter")
-        self.engine = engine
-        self.interval_s = interval_s
-
-    async def _run(self) -> None:
-        import asyncio
-
-        saved_epoch = -1
-        loop = asyncio.get_event_loop()
-        while True:
-            await asyncio.sleep(self.interval_s)
-            epoch = self.engine.spi.mutations
-            if epoch == saved_epoch:
-                continue
-            # collect ON the loop thread (shallow list copies — no dict
-            # can mutate mid-iteration); only codec encode + file IO go
-            # to the executor
-            snap = self.engine.spi.to_snapshot()
-            await loop.run_in_executor(
-                None, self.engine._write_snapshot, snap)
-            saved_epoch = epoch
 
 
 class DeviceManagementService(Service):

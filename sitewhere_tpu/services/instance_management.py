@@ -41,6 +41,8 @@ class InstanceManagementService(Service):
             runtime.settings.jwt_secret,
             expiration_s=runtime.settings.jwt_expiration_s)
         self._bootstrap_admin = ("admin", "password")  # overridable pre-start
+        self._restored_tenants: list[TenantConfig] = []
+        self._snapshotters: list = []
         self.rest = None
         if serve_rest:
             from sitewhere_tpu.rest.api import RestServer
@@ -49,12 +51,105 @@ class InstanceManagementService(Service):
             self.add_child(self.rest)
 
     async def _do_initialize(self, monitor) -> None:
+        # durability: restore users + tenants (entities AND runtime
+        # TenantConfigs) BEFORE the admin bootstrap, so a restored admin
+        # (possibly with a changed password) is never overwritten and
+        # restored tenants respin once the runtime is up
+        self._restored_tenants: list[TenantConfig] = []
+        self._snapshotters = []
+        settings = self.runtime.settings
+        if settings.data_dir:
+            import os
+
+            from sitewhere_tpu.persistence.durable import load_snapshot
+            from sitewhere_tpu.services.snapshot import StoreSnapshotter
+
+            idir = os.path.join(settings.data_dir, "instance")
+            os.makedirs(idir, exist_ok=True)
+            upath = os.path.join(idir, "users.snap")
+            tpath = os.path.join(idir, "tenants.snap")
+            usnap = load_snapshot(upath)
+            if usnap is not None:
+                self.users.restore_snapshot(usnap)
+            tsnap = load_snapshot(tpath)
+            if tsnap is not None:
+                self.tenant_store.restore_snapshot(tsnap)
+                self._restored_tenants = list(tsnap.get("configs", []))
+                logger.info("instance-management: restored %d users, "
+                            "%d tenants", len(self.users.list_users()),
+                            len(self._restored_tenants))
+
+            def collect_tenants() -> dict:
+                snap = self.tenant_store.to_snapshot()
+                snap["configs"] = list(self.runtime.tenants.values())
+                return snap
+
+            if not self._snapshotters:  # restart(): never two loops
+                self._snapshotters = [
+                    StoreSnapshotter("users-snapshotter", upath,
+                                     lambda: self.users.mutations,
+                                     self.users.to_snapshot),
+                    StoreSnapshotter(
+                        "tenants-snapshotter", tpath,
+                        # sum of two MONOTONIC counters: store CRUD and
+                        # runtime config-map changes (add/update/remove
+                        # all bump tenant_epoch)
+                        lambda: (self.tenant_store.mutations
+                                 + self.runtime.tenant_epoch),
+                        collect_tenants),
+                ]
+                for s in self._snapshotters:
+                    self.add_child(s)
         # instance bootstrap (reference: instance templates seed an admin)
         username, password = self._bootstrap_admin
         if self.users.get_user_by_username(username) is None:
             self.users.create_user(
                 User(username=username, first_name="Admin",
                      authorities=ALL_AUTHORITIES), password)
+
+    async def _do_start(self, monitor) -> None:
+        await super()._do_start(monitor)
+        if self._restored_tenants:
+            import asyncio
+
+            self._respin_task = asyncio.create_task(
+                self._respin_restored(), name=f"{self.path}/respin")
+
+    async def _respin_restored(self) -> None:
+        """Re-add restored tenants once EVERY service is started (their
+        tenant-update consumers must be live to build engines)."""
+        import asyncio
+
+        from sitewhere_tpu.kernel.lifecycle import LifecycleStatus
+
+        terminal = (LifecycleStatus.INITIALIZATION_ERROR,
+                    LifecycleStatus.LIFECYCLE_ERROR,
+                    LifecycleStatus.STOPPING, LifecycleStatus.STOPPED,
+                    LifecycleStatus.TERMINATED)
+        while self.runtime.status != LifecycleStatus.STARTED:
+            if self.runtime.status in terminal:
+                logger.warning("respin abandoned: runtime is %s",
+                               self.runtime.status.value)
+                return
+            await asyncio.sleep(0.05)
+        for cfg in self._restored_tenants:
+            if cfg.tenant_id in self.runtime.tenants:
+                continue
+            try:
+                await self.runtime.add_tenant(cfg)
+                logger.info("instance-management: respun tenant %s "
+                            "from snapshot", cfg.tenant_id)
+            except Exception:  # noqa: BLE001 - one tenant can't block the rest
+                logger.exception("respin of restored tenant %s failed",
+                                 cfg.tenant_id)
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        task = getattr(self, "_respin_task", None)
+        if task is not None and not task.done():
+            task.cancel()
+        for s in self._snapshotters:
+            s.save_now()  # clean shutdown loses nothing
 
     # -- auth --------------------------------------------------------------
 

@@ -1,0 +1,106 @@
+"""Round-5 verify drive #5: instance durability through the real CLI.
+
+Boots `swx run` with SWX_DATA_DIR, creates a tenant + user over REST,
+kill -9s the process, reboots the same command, and verifies the
+tenant (respun engines) and user (login works) came back.
+"""
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/tests")
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from test_rest import http  # noqa: E402
+
+DATA = tempfile.mkdtemp(prefix="swx-drive-inst-")
+PORT = 18090
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "SWX_DATA_DIR": DATA}
+
+
+def boot():
+    return subprocess.Popen(
+        [sys.executable, "-m", "sitewhere_tpu.cli", "run",
+         "--port", str(PORT), "--cpu"],
+        cwd="/root/repo", env=ENV,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+
+
+async def wait_rest(proc, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"swx run exited rc={proc.returncode}: "
+                f"{proc.stderr.read()[-2000:]}")
+        try:
+            st, _ = await http(PORT, "POST", "/api/jwt",
+                               basic="admin:password")
+            if st == 200:
+                return
+        except OSError:
+            pass
+        await asyncio.sleep(0.3)
+    raise TimeoutError("REST never came up")
+
+
+async def life1(proc):
+    await wait_rest(proc)
+    _, body = await http(PORT, "POST", "/api/jwt", basic="admin:password")
+    tok = body["token"]
+    st, _ = await http(PORT, "POST", "/api/users", token=tok,
+                       body={"username": "ops", "password": "pw123",
+                             "authorities": ["REST"]})
+    assert st == 200, st
+    st, _ = await http(PORT, "POST", "/api/tenants", token=tok,
+                       body={"token": "acme2",
+                             "sections": {"rule-processing":
+                                          {"model": None}}})
+    assert st == 200, st
+    await asyncio.sleep(1.5)  # snapshot debounce + fsync
+
+
+async def life2(proc):
+    await wait_rest(proc)
+    # restored user logs in through the real auth path
+    st, body = await http(PORT, "POST", "/api/jwt", basic="ops:pw123")
+    assert st == 200, (st, body)
+    _, body = await http(PORT, "POST", "/api/jwt", basic="admin:password")
+    tok = body["token"]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st, tenants = await http(PORT, "GET", "/api/tenants", token=tok)
+        if st == 200 and any(t["token"] == "acme2" for t in tenants):
+            break
+        await asyncio.sleep(0.3)
+    else:
+        raise AssertionError(f"tenant acme2 never respun: {tenants}")
+
+
+p1 = boot()
+try:
+    asyncio.run(life1(p1))
+finally:
+    os.kill(p1.pid, signal.SIGKILL)
+    p1.wait(timeout=10)
+
+p2 = boot()
+try:
+    asyncio.run(life2(p2))
+finally:
+    p2.terminate()
+    p2.wait(timeout=15)
+
+import shutil
+
+shutil.rmtree(DATA)
+print("VERIFY-INSTANCE-DURABLE-OK")
