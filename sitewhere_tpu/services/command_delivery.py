@@ -17,6 +17,7 @@ Tenant config section `command-delivery`:
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import struct
@@ -183,6 +184,52 @@ class WebSocketDeliveryProvider:
         return await listener.send(device.token, payload)
 
 
+class HttpDeliveryProvider:
+    """Push the encoded command to an external HTTP gateway (reference
+    analog: the Twilio-SMS delivery provider — upstream integrates
+    carrier/cloud messaging by POSTing to a service API; same contract
+    here, testable against any local HTTP server). `url_template` may
+    contain `{device}` (device token) and `{type}` (device type id);
+    the body is the encoder's output verbatim
+    (application/octet-stream). 2xx = delivered; failures retry with
+    backoff and then report undelivered (command-delivery's normal
+    undelivered accounting applies)."""
+
+    def __init__(self, url_template: str, retries: int = 3,
+                 backoff_s: float = 0.2, timeout_s: float = 10.0):
+        from sitewhere_tpu.utils.http import parse_http_url
+
+        # validate scheme/shape at config time with a sample substitution
+        parse_http_url(url_template.format(device="x", type="t"),
+                       "http delivery provider")
+        self.url_template = url_template
+        self.retries = max(1, retries)
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        self.delivered = 0
+        self.failed = 0
+
+    async def deliver(self, device: Device, payload: bytes) -> bool:
+        from sitewhere_tpu.utils.http import (
+            http_post_retrying,
+            parse_http_url,
+        )
+
+        url = self.url_template.format(device=device.token,
+                                       type=device.device_type_id)
+        host, port, path = parse_http_url(url)
+        ok, _last = await http_post_retrying(
+            host, port, path, payload,
+            content_type="application/octet-stream",
+            retries=self.retries, backoff_s=self.backoff_s,
+            timeout_s=self.timeout_s)
+        if ok:
+            self.delivered += 1
+        else:
+            self.failed += 1
+        return ok
+
+
 class CoapDeliveryProvider:
     """Deliver commands to a device's own CoAP server (reference:
     the CoAP command-delivery provider beside MQTT/SMS [SURVEY.md §2.2
@@ -234,6 +281,14 @@ class CommandDeliveryEngine(TenantEngine):
                 path=cfg.get("coap_path", "commands"),
                 ack_timeout=cfg.get("coap_ack_timeout", 2.0),
                 max_retransmit=cfg.get("coap_max_retransmit", 2))}
+        # external HTTP gateway push (Twilio-SMS analog): only built
+        # when configured — a URL template is required
+        if cfg.get("http_url"):
+            self.providers["http"] = HttpDeliveryProvider(
+                cfg["http_url"],
+                retries=cfg.get("http_retries", 3),
+                backoff_s=cfg.get("http_backoff_s", 0.2),
+                timeout_s=cfg.get("http_timeout_s", 10.0))
         self.default_encoder = cfg.get("encoder", "json")
         self.default_provider = cfg.get("provider", "queue")
         self.routes: dict[str, dict] = cfg.get("routes", {})

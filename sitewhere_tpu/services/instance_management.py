@@ -56,7 +56,10 @@ class InstanceManagementService(Service):
         # (possibly with a changed password) is never overwritten and
         # restored tenants respin once the runtime is up
         self._restored_tenants: list[TenantConfig] = []
-        self._snapshotters = []
+        # NOTE: self._snapshotters is deliberately NOT reset here —
+        # restart() re-runs _do_initialize and a reset would defeat the
+        # duplicate-loop guard below (two loops → interleaved tmp-file
+        # writes → torn snapshot)
         settings = self.runtime.settings
         if settings.data_dir:
             import os

@@ -34,7 +34,6 @@ import json
 import logging
 import time
 from typing import Awaitable, Callable, Optional
-from urllib.parse import urlsplit
 
 import numpy as np
 
@@ -204,15 +203,11 @@ class WebhookConnector(Connector):
                  filter: Optional[EventFilter] = None, retries: int = 3,
                  backoff_s: float = 0.2, timeout_s: float = 10.0):
         super().__init__(name, filter)
-        parts = urlsplit(url)
-        if parts.scheme != "http":
-            raise ValueError(f"webhook connector supports http:// only, "
-                             f"got {url!r}")
+        from sitewhere_tpu.utils.http import parse_http_url
+
         self.url = url
-        self.host = parts.hostname or "127.0.0.1"
-        self.port = parts.port or 80
-        self.path = (parts.path or "/") + (
-            f"?{parts.query}" if parts.query else "")
+        self.host, self.port, self.path = parse_http_url(
+            url, "webhook connector")
         self.bus = bus
         self.dead_letter_topic = dead_letter_topic
         self.retries = max(1, retries)
@@ -221,44 +216,17 @@ class WebhookConnector(Connector):
         self.delivered = 0
         self.dead_lettered = 0
 
-    async def _post(self, body: bytes) -> int:
-        async def attempt() -> int:
-            reader, writer = await asyncio.open_connection(self.host,
-                                                           self.port)
-            try:
-                writer.write(
-                    (f"POST {self.path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                     f"Content-Type: application/json\r\n"
-                     f"Content-Length: {len(body)}\r\n"
-                     f"Connection: close\r\n\r\n").encode() + body)
-                await writer.drain()
-                status_line = await reader.readline()
-                return int(status_line.split()[1])
-            finally:
-                writer.close()
-
-        # ONE bound over connect + write/drain + status read: an endpoint
-        # that accepts but stops reading must not wedge the outbound loop
-        # (connectors run serially per record) past the timeout
-        return await asyncio.wait_for(attempt(), self.timeout_s)
-
     async def sink(self, value) -> None:
+        from sitewhere_tpu.utils.http import http_post_retrying
+
         body = json.dumps(record_to_jsonable(value)).encode()
-        delay = self.backoff_s
-        last: Optional[BaseException] = None
-        for attempt in range(self.retries):
-            try:
-                status = await self._post(body)
-                if 200 <= status < 300:
-                    self.delivered += 1
-                    return
-                last = RuntimeError(f"HTTP {status}")
-            except (OSError, asyncio.TimeoutError, ValueError,
-                    IndexError) as exc:
-                last = exc
-            if attempt < self.retries - 1:
-                await asyncio.sleep(delay)
-                delay *= 2
+        ok, last = await http_post_retrying(
+            self.host, self.port, self.path, body,
+            retries=self.retries, backoff_s=self.backoff_s,
+            timeout_s=self.timeout_s)
+        if ok:
+            self.delivered += 1
+            return
         self.dead_lettered += 1
         logger.warning("webhook %s → %s failed after %d attempts (%s); "
                        "dead-lettering", self.name, self.url, self.retries,

@@ -206,6 +206,71 @@ def test_encoder_script_guards(run):
     run(main())
 
 
+def test_http_delivery_provider_gateway_push(run):
+    """Commands route to an external HTTP gateway (the Twilio-SMS
+    provider analog): URL templated per device, encoder output POSTed
+    verbatim, 2xx = delivered; a refusing gateway retries then reports
+    undelivered."""
+    async def main():
+        received = []
+
+        async def gateway(reader, writer):
+            req = await reader.readuntil(b"\r\n\r\n")
+            n = 0
+            for line in req.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    n = int(line.split(b":")[1])
+            body = await reader.readexactly(n) if n else b""
+            path = req.split(b" ")[1].decode()
+            received.append((path, body))
+            code = b"503 Down" if path.endswith("/broken") else b"200 OK"
+            writer.write(b"HTTP/1.1 " + code +
+                         b"\r\nContent-Length: 0\r\n\r\n")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(gateway, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+
+        sections = {"command-delivery": {
+            "http_url": f"http://127.0.0.1:{port}/sms/{{device}}",
+            "http_backoff_s": 0.01,
+            "routes": {"thermo": {"encoder": "json",
+                                  "provider": "http"}}}}
+        async with full_instance(sections) as rt:
+            dm = rt.api("device-management").management("acme")
+            dt = dm.get_device_type_by_token("thermo")
+            cmd = dm.create_device_command(DeviceCommand(
+                token="beep", device_type_id=dt.id, name="beep"))
+            device = dm.get_device_by_token("dev-7")
+            assignment = dm.get_active_assignments_for_device(device.id)[0]
+            em = rt.api("event-management").management("acme")
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id)])
+            await wait_until(lambda: received)
+            path, body = received[0]
+            assert path == "/sms/dev-7"
+            assert json.loads(body)["command"] == "beep"
+            provider = (rt.api("command-delivery").delivery("acme")
+                        .providers["http"])
+            assert provider.delivered == 1 and provider.failed == 0
+
+            # refusing endpoint: retries then undelivered accounting
+            provider.url_template = \
+                f"http://127.0.0.1:{port}/sms/{{device}}/broken"
+            before = len(received)
+            await em.add_command_invocations([DeviceCommandInvocation(
+                device_id=device.id, assignment_id=assignment.id,
+                command_id=cmd.id)])
+            await wait_until(lambda: provider.failed == 1, timeout=10.0)
+            assert len(received) - before == provider.retries
+        server.close()
+        await server.wait_closed()
+
+    run(main())
+
+
 def test_rest_connector_and_encoder_script_crud(run):
     """REST CRUD for both new script families + dynamic connector
     attach/detach (mirrors the receiver surface)."""

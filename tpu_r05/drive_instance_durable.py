@@ -28,20 +28,26 @@ ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "SWX_DATA_DIR": DATA}
 
 
 def boot():
-    return subprocess.Popen(
+    # stderr to a file, not a PIPE: nothing drains the pipe while the
+    # server runs, and a chatty boot could fill it and wedge the server
+    errf = open(os.path.join(DATA, "server.err"), "a+")
+    p = subprocess.Popen(
         [sys.executable, "-m", "sitewhere_tpu.cli", "run",
          "--port", str(PORT), "--cpu"],
         cwd="/root/repo", env=ENV,
-        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.DEVNULL, stderr=errf, text=True)
+    p._errf = errf
+    return p
 
 
 async def wait_rest(proc, timeout=60):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if proc.poll() is not None:
+            proc._errf.seek(0)
             raise RuntimeError(
                 f"swx run exited rc={proc.returncode}: "
-                f"{proc.stderr.read()[-2000:]}")
+                f"{proc._errf.read()[-2000:]}")
         try:
             st, _ = await http(PORT, "POST", "/api/jwt",
                                basic="admin:password")
