@@ -10,10 +10,20 @@ OUT=tpu_r05
 mkdir -p "$OUT"
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
-log "watcher started pid=$$"
+# hard deadline: the driver runs BENCH_r05 at round end (~05:15 UTC);
+# this watcher must be silent well before then — a watcher bench leg
+# colliding with the driver's bench would wedge the tunnel for BOTH
+DEADLINE=$(date -u -d "2026-07-31 03:30" +%s)
+past_deadline() { [ "$(date -u +%s)" -ge "$DEADLINE" ]; }
+
+log "watcher started pid=$$ (deadline 2026-07-31T03:30Z)"
 
 # ---- phase 1: probe until healthy ----
 while true; do
+  if past_deadline; then
+    log "deadline reached; watcher exiting (no healthy window)"
+    exit 0
+  fi
   # yield to any running bench (mine or the driver's): a probe's jax
   # import steals enough of this 1-core VM to poison latency tails,
   # and a concurrent TPU process would wedge the tunnel for both
@@ -39,6 +49,10 @@ done
 # ahead instead of burning 10 min per leg) ----
 run() {
   name=$1; shift
+  if past_deadline; then
+    log "SKIP $name: past deadline (driver's bench window)"
+    return
+  fi
   log "RUN $name: python bench.py $*"
   timeout 2700 python bench.py --probe-horizon 120 "$@" \
     > "$OUT/$name.json" 2> "$OUT/$name.err"
