@@ -1,0 +1,113 @@
+"""utils/http.py: the shared dependency-free HTTP client (webhook
+connector + HTTP command-delivery provider both ride it)."""
+
+import asyncio
+
+import pytest
+
+from sitewhere_tpu.utils.http import (
+    http_post,
+    http_post_retrying,
+    parse_http_url,
+)
+
+
+def test_parse_http_url():
+    assert parse_http_url("http://gw:8080/a/b?x=1") == \
+        ("gw", 8080, "/a/b?x=1")
+    assert parse_http_url("http://gw") == ("gw", 80, "/")
+    with pytest.raises(ValueError, match="http:// only"):
+        parse_http_url("https://gw/secure")
+    with pytest.raises(ValueError):
+        parse_http_url("ftp://gw/x")
+
+
+async def _server(handler):
+    srv = await asyncio.start_server(handler, "127.0.0.1", 0)
+    return srv, srv.sockets[0].getsockname()[1]
+
+
+def test_post_and_status(run):
+    async def main():
+        seen = []
+
+        async def handler(reader, writer):
+            req = await reader.readuntil(b"\r\n\r\n")
+            n = int([ln for ln in req.split(b"\r\n")
+                     if ln.lower().startswith(b"content-length")][0]
+                    .split(b":")[1])
+            seen.append((req, await reader.readexactly(n)))
+            writer.write(b"HTTP/1.1 201 Created\r\nContent-Length: 0"
+                         b"\r\n\r\n")
+            await writer.drain()
+            writer.close()
+
+        srv, port = await _server(handler)
+        status = await http_post("127.0.0.1", port, "/x", b"body-bytes",
+                                 content_type="application/octet-stream")
+        assert status == 201
+        req, body = seen[0]
+        assert body == b"body-bytes"
+        assert b"Content-Type: application/octet-stream" in req
+        srv.close()
+        await srv.wait_closed()
+
+    run(main())
+
+
+def test_post_timeout_on_stalled_endpoint(run):
+    """An endpoint that accepts but never answers must not wedge the
+    caller past timeout_s (ONE bound over connect+write+read)."""
+    async def main():
+        stall = asyncio.Event()
+
+        async def handler(reader, writer):
+            try:
+                await stall.wait()
+            finally:
+                writer.close()
+
+        srv, port = await _server(handler)
+        with pytest.raises(asyncio.TimeoutError):
+            await http_post("127.0.0.1", port, "/", b"x", timeout_s=0.3)
+        stall.set()  # release the handler: 3.12 wait_closed() waits for it
+        srv.close()
+        await srv.wait_closed()
+
+    run(main())
+
+
+def test_retrying_backoff_and_accounting(run):
+    async def main():
+        codes = [500, 503, 200]
+        hits = []
+
+        async def handler(reader, writer):
+            await reader.readuntil(b"\r\n\r\n")
+            code = codes[min(len(hits), len(codes) - 1)]
+            hits.append(code)
+            writer.write(f"HTTP/1.1 {code} X\r\nContent-Length: 0"
+                         f"\r\n\r\n".encode())
+            await writer.drain()
+            writer.close()
+
+        srv, port = await _server(handler)
+        ok, last = await http_post_retrying("127.0.0.1", port, "/", b"x",
+                                            retries=3, backoff_s=0.01)
+        assert ok and last is None and hits == [500, 503, 200]
+
+        # exhausted retries: delivered False, last error carries status
+        hits.clear()
+        codes[:] = [500]
+        ok, last = await http_post_retrying("127.0.0.1", port, "/", b"x",
+                                            retries=2, backoff_s=0.01)
+        assert not ok and "HTTP 500" in str(last) and len(hits) == 2
+
+        # connection refused: OSError surfaced as last error
+        srv.close()
+        await srv.wait_closed()
+        ok, last = await http_post_retrying("127.0.0.1", port, "/", b"x",
+                                            retries=2, backoff_s=0.01)
+        assert not ok and isinstance(last, OSError)
+
+    run(main())

@@ -72,10 +72,14 @@ run tft --model tft --devices 1024 --seconds 3 --latency-seconds 2
 run pooled --pooled 8 --devices 8192 --seconds 3 --latency-seconds 2
 run gnn --gnn
 run split --split --devices 4096 --seconds 3 --latency-seconds 2
-log "RUN train: python bench.py --train"
-timeout 3900 python bench.py --probe-horizon 120 --train \
-  > "$OUT/train.json" 2> "$OUT/train.err"
-log "DONE train rc=$? result=$(tail -c 300 "$OUT/train.json" | tr '\n' ' ')"
+if past_deadline; then
+  log "SKIP train: past deadline (driver's bench window)"
+else
+  log "RUN train: python bench.py --train"
+  timeout 3900 python bench.py --probe-horizon 120 --train \
+    > "$OUT/train.json" 2> "$OUT/train.err"
+  log "DONE train rc=$? result=$(tail -c 300 "$OUT/train.json" | tr '\n' ' ')"
+fi
 
 touch "$OUT/DONE"
 log "suite complete"
