@@ -760,6 +760,7 @@ async def run_bench(args) -> dict:
                 "buckets": buckets,  # fleet bucket: 1 flush = 1 XLA call
                 "capacity": per_tenant,   # pre-size the ring: no regrow
                 "max_inflight": args.max_inflight,
+                "readback": args.readback,
                 "shared": pooled,
             },
         }))
@@ -986,6 +987,13 @@ async def run_bench(args) -> dict:
         "model_tflops": round(model_flops_s / 1e12, 3),
         "mfu": round(mfu, 5) if mfu is not None else None,
         "fleet_devices": args.devices,
+        # EFFECTIVE mode, not the flag: pooled runs and window-ring
+        # models silently fall back to full readback — the artifact must
+        # never attribute full-readback numbers to the sparse path
+        "readback": ("anomalies"
+                     if getattr(getattr(session, "ring", None),
+                                "sparse_threshold", None) is not None
+                     else "full"),
         "durable": bool(args.durable),
         "durable_spill": spill,
         "chips": n_chips,
@@ -1058,6 +1066,12 @@ def main() -> None:
                         help=argparse.SUPPRESS)  # internal: subprocess probe
     parser.add_argument("--inner", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run bench bodies
+    parser.add_argument("--readback", default="full",
+                        choices=["full", "anomalies"],
+                        help="'anomalies' thresholds ON DEVICE and ships "
+                             "only anomalous (position, score) pairs home "
+                             "— lifts the tunneled-chip D2H readback "
+                             "ceiling (streaming models only)")
     parser.add_argument("--durable", default=None, metavar="DIR",
                         help="enable the durable event store (segment "
                              "spill + registry snapshots) rooted at DIR; "
@@ -1067,6 +1081,12 @@ def main() -> None:
                         help="run on the CPU backend (the supervisor uses "
                              "this when the accelerator is unreachable)")
     args = parser.parse_args()
+    if args.split and args.readback != "full":
+        # the split child's drain counts scored events per batch; a
+        # sparse batch carries only anomalies, so the drain could never
+        # complete — refuse loudly rather than publish a bogus artifact
+        parser.error("--readback anomalies is not supported with "
+                     "--split (child-side drain counts full batches)")
     if args.force_cpu:
         # must land before ANY jax import: the image re-asserts
         # JAX_PLATFORMS=axon at interpreter startup (see tests/conftest.py)

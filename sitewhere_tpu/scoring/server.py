@@ -72,6 +72,15 @@ class ScoringConfig:
     # need ~3 significant digits; settle upcasts into its float32 result
     # array). "float32" restores exact readback for golden-number work.
     score_dtype: str = "float16"
+    # "full": every score ships device→host (default; exact per-event
+    # scores for sinks/queries). "anomalies": threshold ON DEVICE and
+    # ship only the anomalous (position, score) pairs — the D2H payload
+    # drops ~20×, lifting the tunneled-chip readback ceiling
+    # (streaming models only; see scoring/stream.streaming_step_sparse)
+    readback: str = "full"
+    # anomaly slots per flush in sparse mode; 0 → max(128, bucket/64).
+    # Overflow is counted (scoring.anomaly_overflow), never silent.
+    sparse_k: int = 0
 
     @property
     def backlog_events(self) -> int:
@@ -124,6 +133,7 @@ class ScoringSession:
         self.batch_size_hist = metrics.histogram(
             "scoring.batch_size", buckets=[float(b) for b in cfg.buckets])
         self.anomalies = metrics.counter("scoring.anomalies_detected")
+        self.anomaly_overflow = metrics.counter("scoring.anomaly_overflow")
         self.dropped = metrics.counter("scoring.admissions_dropped")
         self.sink_failures = metrics.counter("scoring.sink_failures")
         # end-to-end latency decomposition (one observation per batch or
@@ -145,14 +155,31 @@ class ScoringSession:
         if getattr(self.model, "streaming", False):
             from sitewhere_tpu.scoring.stream import StreamingRing
 
-            ring = StreamingRing(self.model, capacity=capacity,
-                                 score_dtype=self.cfg.score_dtype)
+            ring = StreamingRing(
+                self.model, capacity=capacity,
+                score_dtype=self.cfg.score_dtype,
+                sparse_threshold=(self.cfg.threshold
+                                  if self.cfg.readback == "anomalies"
+                                  else None),
+                sparse_k=self.cfg.sparse_k)
             ring.bind_params(self.params)
             return ring
+        if self.cfg.readback == "anomalies":
+            logger.warning("readback='anomalies' needs a streaming "
+                           "model; %s uses the window ring — full "
+                           "readback", type(self.model).__name__)
         return DeviceRing(self.model.cfg.window, capacity=capacity,
                           score_dtype=self.cfg.score_dtype)
 
     # -- warmup / params ---------------------------------------------------
+
+    @staticmethod
+    def _result_ready(out) -> bool:
+        """Device-result readiness for plain arrays AND the sparse
+        readback tuples."""
+        if isinstance(out, tuple):
+            return all(a.is_ready() for a in out)
+        return out.is_ready()
 
     def _warm_dispatches(self):
         """Yield one (bucket-compile) device result per call round: the
@@ -174,7 +201,8 @@ class ScoringSession:
         then compile every bucket (tests / tools)."""
         self._load_ring()
         for out in self._warm_dispatches():
-            out.block_until_ready()
+            for arr in (out if isinstance(out, tuple) else (out,)):
+                arr.block_until_ready()
         self.ready = True
 
     async def warmup_async(self) -> None:
@@ -191,7 +219,7 @@ class ScoringSession:
         async def attempt():
             self._load_ring()
             for out in self._warm_dispatches():
-                while not out.is_ready():
+                while not self._result_ready(out):
                     await asyncio.sleep(0.01)
 
         def recover():
@@ -414,10 +442,13 @@ class ScoringSession:
             # start the device→host DMA NOW (non-blocking): by the time a
             # settle thread calls np.asarray the bytes are en route, so
             # the settle holds the GIL for a memcpy, not a device sync
-            try:
-                scores_dev.copy_to_host_async()
-            except AttributeError:
-                pass
+            # (sparse readback returns a tuple of small arrays)
+            for arr in (scores_dev if isinstance(scores_dev, tuple)
+                        else (scores_dev,)):
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
             self.batch_size_hist.observe(float(rdev.shape[0]))
             dispatches.append((scores_dev, rdev.shape[0], rpos))
         return dispatches
@@ -431,10 +462,16 @@ class ScoringSession:
         # commit gate must not consider a flush done until its scored
         # output has been published
         loop = asyncio.get_running_loop()
+
+        def to_host(s):
+            if isinstance(s, tuple):  # sparse: (n_anom, positions, scores)
+                return tuple(np.asarray(x) for x in s)
+            return np.asarray(s)
+
         try:
             try:
                 settled = await asyncio.gather(*[
-                    loop.run_in_executor(SETTLE_POOL, np.asarray, s)
+                    loop.run_in_executor(SETTLE_POOL, to_host, s)
                     for s, _, _ in dispatches])
             except BaseException as exc:
                 if fut is not None and not fut.done():
@@ -448,23 +485,58 @@ class ScoringSession:
                     logger.exception("scoring settle failed")
                     return
                 raise
-            scores = np.empty(dev.shape[0], np.float32)
-            for scores_u, (_, n, rpos) in zip(settled, dispatches):
-                if rpos is None:
-                    scores[:n] = scores_u[:n]
-                else:
-                    scores[rpos] = scores_u[:n]
+            # mode-independent accounting: BOTH paths scored every event
+            # on device (sparse just ships fewer scores home)
             now = time.monotonic()
             self.stage_device.observe(now - t0)
             self.scored_meter.mark(dev.shape[0])
             self.latency.observe_array(now - ingest)
             self.batch_latency.observe(now - t0)
-            is_anom = scores >= self.cfg.threshold
-            n_anom = int(is_anom.sum())
-            if n_anom:
-                self.anomalies.inc(n_anom)
-            scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
-                                 model_version=self.version)
+            if settled and isinstance(settled[0], tuple):
+                # sparse anomaly readback: reconstruct the anomalous
+                # subset only
+                anom_flush_pos: list[np.ndarray] = []
+                anom_scores: list[np.ndarray] = []
+                for (n_anom, pos, vals), (_, n, rpos) in zip(settled,
+                                                             dispatches):
+                    k_eff = min(int(n_anom), pos.shape[0])
+                    if int(n_anom) > pos.shape[0]:
+                        self.anomaly_overflow.inc(int(n_anom)
+                                                  - pos.shape[0])
+                    if k_eff == 0:
+                        continue
+                    p = pos[:k_eff]
+                    keep = p < n          # bucket padding can't report
+                    p, v_ = p[keep], vals[:k_eff][keep]
+                    # rounds remap duplicate-device chunks back to the
+                    # original flush positions
+                    anom_flush_pos.append(p if rpos is None else rpos[p])
+                    anom_scores.append(v_.astype(np.float32))
+                if anom_flush_pos:
+                    fpos = np.concatenate(anom_flush_pos)
+                    a_scores = np.concatenate(anom_scores)
+                else:
+                    fpos = np.empty(0, np.int64)
+                    a_scores = np.empty(0, np.float32)
+                self.anomalies.inc(int(fpos.shape[0]))
+                scored = ScoredBatch(
+                    ctx, dev[fpos], a_scores,
+                    np.ones(fpos.shape[0], bool), ts[fpos],
+                    model_version=self.version,
+                    total_scored=int(dev.shape[0]))
+            else:
+                scores = np.empty(dev.shape[0], np.float32)
+                for scores_u, (_, n, rpos) in zip(settled, dispatches):
+                    if rpos is None:
+                        scores[:n] = scores_u[:n]
+                    else:
+                        scores[rpos] = scores_u[:n]
+                is_anom = scores >= self.cfg.threshold
+                n_anom = int(is_anom.sum())
+                if n_anom:
+                    self.anomalies.inc(n_anom)
+                scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
+                                     model_version=self.version)
             if self.tracer is not None:
                 for trace_id, n_ev in (traces or [(ctx.trace_id,
                                                    dev.shape[0])]):
@@ -533,7 +605,7 @@ class ScoringSession:
                 while self._pending_max >= self.ring.capacity:
                     self.ring.ensure_capacity(self._pending_max)
                     for out in self._warm_dispatches():
-                        while not out.is_ready():
+                        while not self._result_ready(out):
                             await asyncio.sleep(0.01)
 
             await retry_backoff(attempt, self._recover_ring, logger,
@@ -574,12 +646,17 @@ class ScoringSession:
         batches = [await f for f in futs]
         if len(batches) == 1:
             return batches[0]
+        sparse = any(b.total_scored >= 0 for b in batches)
         return ScoredBatch(
             ctx, np.concatenate([b.device_index for b in batches]),
             np.concatenate([b.score for b in batches]),
             np.concatenate([b.is_anomaly for b in batches]),
             np.concatenate([b.ts for b in batches]),
-            model_version=self.version)
+            model_version=self.version,
+            # sparse chunks: the merged batch's scored-count is the sum
+            # of chunk counts, NOT len(self) (-1 means full readback)
+            total_scored=(sum(max(b.total_scored, len(b))
+                              for b in batches) if sparse else -1))
 
     def _recover_ring(self) -> None:
         # the faulted ring's donated buffers are gone — allocate fresh

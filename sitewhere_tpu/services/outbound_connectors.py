@@ -89,9 +89,7 @@ class EventFilter:
             mask = value.score >= self.min_score
             if not mask.any():
                 return None
-            value = ScoredBatch(value.ctx, value.device_index[mask],
-                                value.score[mask], value.is_anomaly[mask],
-                                value.ts[mask], value.model_version)
+            value = value.select(mask)  # preserves total_scored
         return value
 
 

@@ -110,6 +110,9 @@ class RuleProcessingEngine(TenantEngine):
             capacity=cfg.get("capacity", 0),
             max_inflight=cfg.get("max_inflight", 64),
             backlog_cap=cfg.get("backlog_cap", 0),
+            score_dtype=cfg.get("score_dtype", "float16"),
+            readback=cfg.get("readback", "full"),
+            sparse_k=cfg.get("sparse_k", 0),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.shared: bool = cfg.get("shared", False)
@@ -139,6 +142,12 @@ class RuleProcessingEngine(TenantEngine):
         em = await self.runtime.wait_for_engine("event-management",
                                                 self.tenant_id)
         if self.shared:
+            if self.scoring_cfg.readback != "full":
+                logger.warning(
+                    "rule-processing[%s]: readback=%r is dedicated-"
+                    "session only; the shared pool (stacked ring) runs "
+                    "full readback", self.tenant_id,
+                    self.scoring_cfg.readback)
             pool = self.service.shared_pool(
                 self.model_name, self.model_config, self.scoring_cfg,
                 self.mesh_spec)

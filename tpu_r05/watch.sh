@@ -64,6 +64,8 @@ run() {
 run default                       # driver-shaped: plain defaults
 run headline --seconds 5 --latency-seconds 3 --model lstm-stream --paced-fraction 0.4 --devices 16384
 run headline_i16 --seconds 5 --latency-seconds 3 --model lstm-stream --paced-fraction 0.4 --devices 16384 --max-inflight 16
+run headline_sparse --seconds 5 --latency-seconds 3 --model lstm-stream --paced-fraction 0.4 --devices 16384 --readback anomalies
+run headline_sparse_i16 --seconds 5 --latency-seconds 3 --model lstm-stream --paced-fraction 0.4 --devices 16384 --readback anomalies --max-inflight 16
 run lstm_pallas --model lstm --seconds 5 --latency-seconds 3 --devices 16384
 export SWX_DISABLE_PALLAS=1
 run lstm_scan --model lstm --seconds 5 --latency-seconds 3 --devices 16384
