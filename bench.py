@@ -987,12 +987,16 @@ async def run_bench(args) -> dict:
         "model_tflops": round(model_flops_s / 1e12, 3),
         "mfu": round(mfu, 5) if mfu is not None else None,
         "fleet_devices": args.devices,
-        # EFFECTIVE mode, not the flag: pooled runs and window-ring
-        # models silently fall back to full readback — the artifact must
-        # never attribute full-readback numbers to the sparse path
-        "readback": ("anomalies"
-                     if getattr(getattr(session, "ring", None),
-                                "sparse_threshold", None) is not None
+        # EFFECTIVE mode, not the flag: window-ring models fall back to
+        # full readback — the artifact must never attribute
+        # full-readback numbers to the sparse path. Dedicated sessions
+        # expose .ring (StreamingRing.sparse_threshold); pooled slots
+        # reach the pool's stacked ring (.sparse).
+        "readback": ("anomalies" if (
+            getattr(getattr(session, "ring", None),
+                    "sparse_threshold", None) is not None
+            or getattr(getattr(getattr(session, "pool", None),
+                               "ring", None), "sparse", False))
                      else "full"),
         "durable": bool(args.durable),
         "durable_spill": spill,

@@ -59,6 +59,10 @@ class PoolConfig:
     backlog_cap: int = 0
     # flush-path score readback dtype (see ScoringConfig.score_dtype)
     score_dtype: str = "float16"
+    # sparse anomaly readback (see ScoringConfig.readback): pooled form
+    # uses per-tenant thresholds as a runtime [T] vector
+    readback: str = "full"
+    sparse_k: int = 0
 
     @property
     def backlog_events(self) -> int:
@@ -206,6 +210,7 @@ class SharedScoringPool:
         self.latency = metrics.histogram("scoring.e2e_latency_s")
         self.batch_latency = metrics.histogram("scoring.batch_latency_s")
         self.anomalies = metrics.counter("scoring.anomalies_detected")
+        self.anomaly_overflow = metrics.counter("scoring.anomaly_overflow")
         self.flush_rounds = metrics.counter("scoring.pool_flush_rounds")
         self.dropped = metrics.counter("scoring.admissions_dropped")
         self.sink_failures = metrics.counter("scoring.sink_failures")
@@ -257,7 +262,13 @@ class SharedScoringPool:
 
             return StackedStreamingRing(
                 self.model, self.stack.capacity, device_cap=device_cap,
-                mesh=self.mesh, score_dtype=self.cfg.score_dtype)
+                mesh=self.mesh, score_dtype=self.cfg.score_dtype,
+                sparse=self.cfg.readback == "anomalies",
+                sparse_k=self.cfg.sparse_k)
+        if self.cfg.readback == "anomalies":
+            logger.warning("readback='anomalies' needs a streaming "
+                           "model; %s uses the stacked window ring — "
+                           "full readback", type(self.model).__name__)
         return StackedDeviceRing(
             self.model.cfg.window, self.stack.capacity,
             device_cap=device_cap, mesh=self.mesh,
@@ -326,9 +337,15 @@ class SharedScoringPool:
                     dev = np.full((self.ring.t_cap, b), self.ring.device_cap,
                                   np.int32)
                     v = np.zeros((self.ring.t_cap, b), np.float32)
-                    out = self.ring.update_and_score(
-                        self.model, self.stack.stacked, dev, v)
-                    while not out.is_ready():
+                    if getattr(self.ring, "sparse", False):
+                        out = self.ring.update_and_score(
+                            self.model, self.stack.stacked, dev, v,
+                            thresholds=self._thresholds())
+                    else:
+                        out = self.ring.update_and_score(
+                            self.model, self.stack.stacked, dev, v)
+                    from sitewhere_tpu.scoring.stream import result_ready
+                    while not result_ready(out):
                         await asyncio.sleep(0.01)
                     if self._current_key() != key:
                         break  # grew mid-warmup; recompile at new shapes
@@ -370,6 +387,16 @@ class SharedScoringPool:
     @property
     def _total_pending(self) -> int:
         return sum(e.pending_n for e in self.tenants.values())
+
+    def _thresholds(self) -> np.ndarray:
+        """Per-slot alert bars for the sparse step ([T_cap] f32);
+        empty slots get +inf so they can never report."""
+        th = np.full(self.ring.t_cap, np.inf, np.float32)
+        for tid, e in self.tenants.items():
+            slot = self.stack.slots.get(tid)
+            if slot is not None and slot < th.shape[0]:
+                th[slot] = e.threshold
+        return th
 
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.batch_buckets:
@@ -501,8 +528,13 @@ class SharedScoringPool:
                 for slot, rdev, rval in parts:
                     dev_in[slot, :rdev.shape[0]] = rdev
                     val_in[slot, :rdev.shape[0]] = rval
-                dispatches.append(self.ring.update_and_score(
-                    self.model, self.stack.stacked, dev_in, val_in))
+                if getattr(self.ring, "sparse", False):
+                    dispatches.append(self.ring.update_and_score(
+                        self.model, self.stack.stacked, dev_in, val_in,
+                        thresholds=self._thresholds()))
+                else:
+                    dispatches.append(self.ring.update_and_score(
+                        self.model, self.stack.stacked, dev_in, val_in))
         except Exception:
             logger.exception("pool dispatch failed; reseeding ring")
             self.dropped.inc(sum(m[2] for m in metas))
@@ -522,10 +554,12 @@ class SharedScoringPool:
     async def _settle_and_deliver(self, dispatches, metas, t0: float,
                                   seq: Optional[int] = None) -> None:
         loop = asyncio.get_running_loop()
+        from sitewhere_tpu.scoring.stream import result_to_host as to_host
+
         try:
             try:
                 settled = await asyncio.gather(*[
-                    loop.run_in_executor(SETTLE_POOL, np.asarray, s)
+                    loop.run_in_executor(SETTLE_POOL, to_host, s)
                     for s in dispatches])
             except BaseException as exc:
                 self.dropped.inc(sum(m[2] for m in metas))
@@ -536,24 +570,59 @@ class SharedScoringPool:
             now = time.monotonic()
             self.batch_latency.observe(now - t0)
             self.stage_device.observe(now - t0)
+            sparse = bool(settled) and isinstance(settled[0], tuple)
             for tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx in metas:
                 e = self.tenants.get(tid)
                 if e is None:  # unregistered mid-flight
                     continue
-                scores = np.empty(n, np.float32)
-                for r, rpos, k in ev_rounds:
-                    if rpos is None:
-                        scores[:k] = settled[r][slot, :k]
-                    else:
-                        scores[rpos] = settled[r][slot, :k]
-                is_anom = scores >= e.threshold
                 self.scored_meter.mark(n)
                 self.latency.observe_array(now - ing)
-                n_anom = int(is_anom.sum())
-                if n_anom:
-                    self.anomalies.inc(n_anom)
-                scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
-                                     model_version=self.stack.versions[tid])
+                if sparse:
+                    # per-tenant anomalous subset: remap round-local
+                    # positions back to this tenant's take positions
+                    anom_pos: list[np.ndarray] = []
+                    anom_scores: list[np.ndarray] = []
+                    for r, rpos, k in ev_rounds:
+                        n_anom_t, pos_t, vals_t = (
+                            settled[r][0][slot], settled[r][1][slot],
+                            settled[r][2][slot])
+                        k_eff = min(int(n_anom_t), pos_t.shape[0])
+                        if int(n_anom_t) > pos_t.shape[0]:
+                            self.anomaly_overflow.inc(
+                                int(n_anom_t) - pos_t.shape[0])
+                        if k_eff == 0:
+                            continue
+                        p = pos_t[:k_eff]
+                        keep = p < k          # bucket padding
+                        p, v_ = p[keep], vals_t[:k_eff][keep]
+                        anom_pos.append(p if rpos is None else rpos[p])
+                        anom_scores.append(v_.astype(np.float32))
+                    if anom_pos:
+                        fpos = np.concatenate(anom_pos)
+                        a_scores = np.concatenate(anom_scores)
+                    else:
+                        fpos = np.empty(0, np.int64)
+                        a_scores = np.empty(0, np.float32)
+                    self.anomalies.inc(int(fpos.shape[0]))
+                    scored = ScoredBatch(
+                        ctx, dev[fpos], a_scores,
+                        np.ones(fpos.shape[0], bool), ts[fpos],
+                        model_version=self.stack.versions[tid],
+                        total_scored=n)
+                else:
+                    scores = np.empty(n, np.float32)
+                    for r, rpos, k in ev_rounds:
+                        if rpos is None:
+                            scores[:k] = settled[r][slot, :k]
+                        else:
+                            scores[rpos] = settled[r][slot, :k]
+                    is_anom = scores >= e.threshold
+                    n_anom = int(is_anom.sum())
+                    if n_anom:
+                        self.anomalies.inc(n_anom)
+                    scored = ScoredBatch(
+                        ctx, dev, scores, is_anom, ts,
+                        model_version=self.stack.versions[tid])
                 if self.tracer is not None:
                     for trace_id, n_ev in traces:
                         self.tracer.record(trace_id, "rule-processing.score",

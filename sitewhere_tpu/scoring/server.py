@@ -175,11 +175,9 @@ class ScoringSession:
 
     @staticmethod
     def _result_ready(out) -> bool:
-        """Device-result readiness for plain arrays AND the sparse
-        readback tuples."""
-        if isinstance(out, tuple):
-            return all(a.is_ready() for a in out)
-        return out.is_ready()
+        from sitewhere_tpu.scoring.stream import result_ready
+
+        return result_ready(out)
 
     def _warm_dispatches(self):
         """Yield one (bucket-compile) device result per call round: the
@@ -463,10 +461,7 @@ class ScoringSession:
         # output has been published
         loop = asyncio.get_running_loop()
 
-        def to_host(s):
-            if isinstance(s, tuple):  # sparse: (n_anom, positions, scores)
-                return tuple(np.asarray(x) for x in s)
-            return np.asarray(s)
+        from sitewhere_tpu.scoring.stream import result_to_host as to_host
 
         try:
             try:

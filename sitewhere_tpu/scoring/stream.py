@@ -59,7 +59,7 @@ def streaming_step(model, out_dtype=None) -> Callable:
     return step
 
 
-def streaming_step_sparse(model, threshold: float, k: int,
+def streaming_step_sparse(model, k: int,
                           scratch_index: int, out_dtype=None) -> Callable:
     """`streaming_step` with DEVICE-SIDE thresholding: every event is
     still scored and state-advanced on chip, but only the anomalous
@@ -78,9 +78,13 @@ def streaming_step_sparse(model, threshold: float, k: int,
     into the flush's padded bucket, sorted score-descending; entries
     past `min(n_anom, k)` are padding. `n_anom > k` means overflow —
     the host counts it (`scoring.anomaly_overflow`) so a silent top-k
-    truncation is impossible."""
+    truncation is impossible.
 
-    def step(params, state, dev, v):
+    `threshold` is a RUNTIME argument (scalar here; the stacked ring
+    vmaps it into a per-tenant vector — pooled tenants each set their
+    own alert bar) so threshold changes never recompile."""
+
+    def step(params, state, dev, v, threshold):
         rows = jax.tree.map(lambda leaf: leaf[dev], state)
         scores, new_rows = model.step_score(params, rows, v)
 
@@ -99,6 +103,21 @@ def streaming_step_sparse(model, threshold: float, k: int,
         return state, (n_anom, top_pos.astype(jnp.int32), top_scores)
 
     return step
+
+
+def result_ready(out) -> bool:
+    """Device-result readiness for plain score arrays AND the sparse
+    readback tuples — the single place that knows the tuple shape."""
+    if isinstance(out, tuple):
+        return all(a.is_ready() for a in out)
+    return out.is_ready()
+
+
+def result_to_host(out):
+    """Settle-thread conversion for plain arrays AND sparse tuples."""
+    if isinstance(out, tuple):
+        return tuple(np.asarray(x) for x in out)
+    return np.asarray(out)
 
 
 class StreamingRing:
@@ -169,7 +188,7 @@ class StreamingRing:
         if self.sparse_threshold is not None:
             k = self.sparse_k or max(128, bucket // 64)
             return jax.jit(streaming_step_sparse(
-                self.model, self.sparse_threshold, min(k, bucket),
+                self.model, min(k, bucket),
                 scratch_index=cap, out_dtype=self.score_dtype),
                 donate_argnums=(1,))
         return jax.jit(streaming_step(self.model, self.score_dtype),
@@ -195,7 +214,12 @@ class StreamingRing:
             fn = self._fns[key] = self._build_step(self.capacity, bucket)
         pdev, pv = self._pad(dev, v, bucket)
         try:
-            self.state, scores = fn(params, self.state, pdev, pv)
+            if self.sparse_threshold is not None:
+                self.state, scores = fn(
+                    params, self.state, pdev, pv,
+                    np.float32(self.sparse_threshold))
+            else:
+                self.state, scores = fn(params, self.state, pdev, pv)
         except Exception:
             self.faulted = True  # donated state is gone; needs load()
             raise
@@ -229,13 +253,19 @@ class StackedStreamingRing:
     """
 
     def __init__(self, model, n_tenants: int, device_cap: int = 1024,
-                 mesh=None, score_dtype=None):
+                 mesh=None, score_dtype=None, sparse: bool = False,
+                 sparse_k: int = 0):
         from sitewhere_tpu.parallel.mesh import tenant_placer
 
         self.model = model
         self.window = int(model.cfg.window)
         self.mesh = mesh
         self.score_dtype = jnp.dtype(score_dtype) if score_dtype else None
+        # sparse anomaly readback, pooled form: per-tenant thresholds
+        # ride as a [T_cap] runtime vector (each tenant sets its own
+        # alert bar at register())
+        self.sparse = sparse
+        self.sparse_k = sparse_k
         self.t_cap = int(n_tenants)
         self.device_cap = grow_pow2(int(device_cap), floor=1024)
         self._fns: dict[tuple, Callable] = {}
@@ -313,22 +343,38 @@ class StackedStreamingRing:
 
     # -- compiled step -----------------------------------------------------
 
-    def _build_step(self) -> Callable:
+    def _build_step(self, bucket: int) -> Callable:
+        if self.sparse:
+            k = self.sparse_k or max(128, bucket // 64)
+            return jax.jit(jax.vmap(streaming_step_sparse(
+                self.model, min(k, bucket),
+                scratch_index=self.device_cap,
+                out_dtype=self.score_dtype)),
+                donate_argnums=(1,))
         return jax.jit(jax.vmap(streaming_step(self.model, self.score_dtype)),
                        donate_argnums=(1,))
 
     def update_and_score(self, model, stacked_params, dev: np.ndarray,
-                         v: np.ndarray) -> jax.Array:
+                         v: np.ndarray, thresholds=None):
         """dev: [T_cap, B] int32 (scratch-row-padded, unique ids per
         tenant row!), v: [T_cap, B] float32 → [T_cap, B] scores on
-        device (async)."""
-        key = ("ss", self.t_cap, self.device_cap, dev.shape[1])
+        device (async); sparse mode returns per-tenant
+        (n_anom[T], positions[T, k], scores[T, k]) and needs
+        `thresholds` [T_cap] float32."""
+        key = ("ss", self.sparse, self.t_cap, self.device_cap,
+               dev.shape[1])
         fn = self._fns.get(key)
         if fn is None:
-            fn = self._fns[key] = self._build_step()
+            fn = self._fns[key] = self._build_step(dev.shape[1])
         try:
-            self.state, scores = fn(stacked_params, self.state,
-                                    jnp.asarray(dev), jnp.asarray(v))
+            if self.sparse:
+                self.state, scores = fn(stacked_params, self.state,
+                                        jnp.asarray(dev), jnp.asarray(v),
+                                        jnp.asarray(thresholds,
+                                                    jnp.float32))
+            else:
+                self.state, scores = fn(stacked_params, self.state,
+                                        jnp.asarray(dev), jnp.asarray(v))
         except Exception:
             self.faulted = True  # donated state is gone; needs reseeding
             raise

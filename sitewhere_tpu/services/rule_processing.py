@@ -142,12 +142,6 @@ class RuleProcessingEngine(TenantEngine):
         em = await self.runtime.wait_for_engine("event-management",
                                                 self.tenant_id)
         if self.shared:
-            if self.scoring_cfg.readback != "full":
-                logger.warning(
-                    "rule-processing[%s]: readback=%r is dedicated-"
-                    "session only; the shared pool (stacked ring) runs "
-                    "full readback", self.tenant_id,
-                    self.scoring_cfg.readback)
             pool = self.service.shared_pool(
                 self.model_name, self.model_config, self.scoring_cfg,
                 self.mesh_spec)
@@ -399,7 +393,13 @@ class RuleProcessingService(Service):
 
         key = (model_name,
                json.dumps(model_config, sort_keys=True, default=str),
-               scoring_cfg.mtype)
+               scoring_cfg.mtype,
+               # ring-shaping knobs are baked into the compiled step:
+               # tenants differing in ANY of them must not share a pool
+               # (a silently-shared sparse_k would drop one tenant's
+               # overflow anomalies with no trace but a counter)
+               scoring_cfg.readback, scoring_cfg.sparse_k,
+               scoring_cfg.score_dtype)
         pool = self._pools.get(key)
         if pool is None:
             mesh = None
@@ -413,7 +413,10 @@ class RuleProcessingService(Service):
                 PoolConfig(batch_buckets=scoring_cfg.buckets,
                            batch_window_ms=scoring_cfg.batch_window_ms,
                            mtype=scoring_cfg.mtype, seed=scoring_cfg.seed,
-                           backlog_cap=scoring_cfg.backlog_cap),
+                           backlog_cap=scoring_cfg.backlog_cap,
+                           score_dtype=scoring_cfg.score_dtype,
+                           readback=scoring_cfg.readback,
+                           sparse_k=scoring_cfg.sparse_k),
                 mesh=mesh, tracer=self.runtime.tracer)
             self._pools[key] = pool
         return pool

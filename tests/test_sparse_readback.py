@@ -186,3 +186,69 @@ def test_sparse_e2e_alert_parity(run):
             assert set(np.nonzero(truth)[0]) <= alert_devs
 
     run(main())
+
+
+def test_pool_sparse_matches_pool_full(run):
+    """Pooled form (config 4): per-tenant thresholds ride as a device
+    vector; sparse pool reports the same anomaly sets the full pool
+    does — different alert bars per tenant respected."""
+    async def main():
+        import jax
+
+        from sitewhere_tpu.scoring.pool import (
+            PoolConfig,
+            SharedScoringPool,
+        )
+        from tests.test_streaming import _make_pool_tenant
+
+        model = build_model("lstm-stream", window=64)
+        params = {tid: model.init(jax.random.PRNGKey(i + 10))
+                  for i, tid in enumerate(("a", "b"))}
+        pools = {}
+        delivered = {"full": {}, "anomalies": {}}
+        stores = {"full": {}, "anomalies": {}}
+        sims = {"full": {}, "anomalies": {}}
+        for mode in ("full", "anomalies"):
+            pool = SharedScoringPool(
+                model, MetricsRegistry(),
+                PoolConfig(batch_buckets=(64,), batch_window_ms=1.0,
+                           readback=mode))
+            pools[mode] = pool
+            for i, tid in enumerate(("a", "b")):
+                # tenant b gets a stricter bar than tenant a
+                stores[mode][tid], sims[mode][tid], _ = _make_pool_tenant(
+                    pool, tid, 30, i + 20, delivered[mode],
+                    params=params[tid],
+                    threshold=4.0 if tid == "a" else 6.0)
+            await wait_until(lambda p=pool: p.ready, timeout=60.0)
+
+        anomaly = dict(anomaly_rate=0.1, anomaly_magnitude=12.0)
+        for k in range(3):
+            for mode in ("full", "anomalies"):
+                for i, tid in enumerate(("a", "b")):
+                    sims[mode][tid].cfg = SimConfig(
+                        num_devices=30, seed=i + 20, **anomaly)
+                    batch, _ = sims[mode][tid].tick(t=(70 + k) * 60.0)
+                    stores[mode][tid].append_measurements(batch)
+                    pools[mode].admit(tid, batch)
+            await wait_until(
+                lambda k=k: all(len(delivered[m][t]) >= k + 1
+                                for m in ("full", "anomalies")
+                                for t in ("a", "b")), timeout=30.0)
+            for tid in ("a", "b"):
+                got_f = delivered["full"][tid][k]
+                got_s = delivered["anomalies"][tid][k]
+                f_anom = {int(d): float(s) for d, s in zip(
+                    got_f.device_index[got_f.is_anomaly],
+                    got_f.score[got_f.is_anomaly])}
+                s_anom = {int(d): float(s) for d, s in zip(
+                    got_s.device_index, got_s.score)}
+                assert set(s_anom) == set(f_anom), (tid, k)
+                for d in f_anom:
+                    assert abs(s_anom[d] - f_anom[d]) <= 2e-2 * max(
+                        1.0, abs(f_anom[d]))
+                assert got_s.total_scored == 30
+        for pool in pools.values():
+            pool.close()
+
+    run(main())
