@@ -578,25 +578,22 @@ class SharedScoringPool:
                 self.scored_meter.mark(n)
                 self.latency.observe_array(now - ing)
                 if sparse:
+                    from sitewhere_tpu.scoring.stream import sparse_take
+
                     # per-tenant anomalous subset: remap round-local
                     # positions back to this tenant's take positions
                     anom_pos: list[np.ndarray] = []
                     anom_scores: list[np.ndarray] = []
                     for r, rpos, k in ev_rounds:
-                        n_anom_t, pos_t, vals_t = (
+                        p, v_, overflow = sparse_take(
                             settled[r][0][slot], settled[r][1][slot],
-                            settled[r][2][slot])
-                        k_eff = min(int(n_anom_t), pos_t.shape[0])
-                        if int(n_anom_t) > pos_t.shape[0]:
-                            self.anomaly_overflow.inc(
-                                int(n_anom_t) - pos_t.shape[0])
-                        if k_eff == 0:
+                            settled[r][2][slot], k)
+                        if overflow:
+                            self.anomaly_overflow.inc(overflow)
+                        if p.shape[0] == 0:
                             continue
-                        p = pos_t[:k_eff]
-                        keep = p < k          # bucket padding
-                        p, v_ = p[keep], vals_t[:k_eff][keep]
                         anom_pos.append(p if rpos is None else rpos[p])
-                        anom_scores.append(v_.astype(np.float32))
+                        anom_scores.append(v_)
                     if anom_pos:
                         fpos = np.concatenate(anom_pos)
                         a_scores = np.concatenate(anom_scores)

@@ -490,23 +490,21 @@ class ScoringSession:
             if settled and isinstance(settled[0], tuple):
                 # sparse anomaly readback: reconstruct the anomalous
                 # subset only
+                from sitewhere_tpu.scoring.stream import sparse_take
+
                 anom_flush_pos: list[np.ndarray] = []
                 anom_scores: list[np.ndarray] = []
                 for (n_anom, pos, vals), (_, n, rpos) in zip(settled,
                                                              dispatches):
-                    k_eff = min(int(n_anom), pos.shape[0])
-                    if int(n_anom) > pos.shape[0]:
-                        self.anomaly_overflow.inc(int(n_anom)
-                                                  - pos.shape[0])
-                    if k_eff == 0:
+                    p, v_, overflow = sparse_take(n_anom, pos, vals, n)
+                    if overflow:
+                        self.anomaly_overflow.inc(overflow)
+                    if p.shape[0] == 0:
                         continue
-                    p = pos[:k_eff]
-                    keep = p < n          # bucket padding can't report
-                    p, v_ = p[keep], vals[:k_eff][keep]
                     # rounds remap duplicate-device chunks back to the
                     # original flush positions
                     anom_flush_pos.append(p if rpos is None else rpos[p])
-                    anom_scores.append(v_.astype(np.float32))
+                    anom_scores.append(v_)
                 if anom_flush_pos:
                     fpos = np.concatenate(anom_flush_pos)
                     a_scores = np.concatenate(anom_scores)

@@ -120,6 +120,24 @@ def result_to_host(out):
     return np.asarray(out)
 
 
+def sparse_take(n_anom, pos, vals,
+                n_real: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """Host-side reconstruction for ONE sparse result row: clamp to the
+    k slots, drop bucket-padding positions (>= n_real — device-side
+    scratch masking makes this belt-and-braces), upcast scores.
+    Returns (positions, scores_f32, overflow). Shared by the dedicated
+    session's per-chunk settle and the pool's per-tenant-per-round
+    settle so the overflow/remap accounting cannot drift between the
+    two hot paths."""
+    k_eff = min(int(n_anom), pos.shape[0])
+    overflow = max(0, int(n_anom) - pos.shape[0])
+    if k_eff == 0:
+        return (np.empty(0, pos.dtype), np.empty(0, np.float32), overflow)
+    p = pos[:k_eff]
+    keep = p < n_real
+    return p[keep], vals[:k_eff][keep].astype(np.float32), overflow
+
+
 class StreamingRing:
     """Per-device streaming model state for up to `capacity` devices,
     plus one scratch row (index `capacity`) that absorbs padding."""

@@ -398,7 +398,11 @@ class RuleProcessingService(Service):
                # tenants differing in ANY of them must not share a pool
                # (a silently-shared sparse_k would drop one tenant's
                # overflow anomalies with no trace but a counter)
-               scoring_cfg.readback, scoring_cfg.sparse_k,
+               scoring_cfg.readback,
+               # sparse_k is inert in full mode — don't split pools on
+               # a leftover knob
+               (scoring_cfg.sparse_k
+                if scoring_cfg.readback == "anomalies" else 0),
                scoring_cfg.score_dtype)
         pool = self._pools.get(key)
         if pool is None:
