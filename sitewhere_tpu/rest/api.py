@@ -260,10 +260,58 @@ class RestServer(LifecycleComponent):
                authority: Optional[str] = AUTH_REST) -> None:
         self._routes.append((method, re.compile(pattern), handler, authority))
 
+    # -- OpenAPI (reference: the Swagger UI instance-management hosts) -----
+
+    async def get_openapi(self, req: Request) -> dict:
+        """Machine-readable API description generated from the live
+        route table (every route, its JWT authority, and its path
+        params) — the rebuild's Swagger analog. Unauthenticated, like
+        upstream's swagger.json."""
+        if getattr(self, "_openapi", None) is None:
+            self._openapi = self._build_openapi()
+        return self._openapi
+
+    def _build_openapi(self) -> dict:
+        paths: dict = {}
+        for method, pattern, handler, authority in self._routes:
+            path = re.sub(r"\(\?P<([^>]+)>[^)]*\)", r"{\1}",
+                          pattern.pattern)
+            doc = (handler.__doc__ or "").strip().split("\n")[0]
+            op = {
+                "operationId": handler.__name__,
+                "summary": doc or handler.__name__.replace("_", " "),
+                "responses": {"200": {"description": "OK"}},
+            }
+            params = re.findall(r"\{([^}]+)\}", path)
+            if params:
+                op["parameters"] = [
+                    {"name": p, "in": "path", "required": True,
+                     "schema": {"type": "string"}} for p in params]
+            if authority is not None:
+                op["security"] = [{"bearerAuth": []}]
+                # the JWT must carry this authority (kernel/security.py)
+                op["x-authority"] = authority
+            paths.setdefault(path, {})[method.lower()] = op
+        return {
+            "openapi": "3.0.3",
+            "info": {
+                "title": "swx REST API",
+                "description": "TPU-native device-event platform "
+                               "(SiteWhere-compatible resource layout; "
+                               "see docs/MIGRATION.md)",
+                "version": __import__("sitewhere_tpu").__version__,
+            },
+            "components": {"securitySchemes": {"bearerAuth": {
+                "type": "http", "scheme": "bearer",
+                "bearerFormat": "JWT"}}},
+            "paths": paths,
+        }
+
     def _install_routes(self) -> None:
         r = self._route
         # auth + instance
         r("POST", r"/api/jwt", self.post_jwt, authority=None)
+        r("GET", r"/api/openapi\.json", self.get_openapi, authority=None)
         r("GET", r"/api/instance/health", self.get_health, authority=None)
         r("GET", r"/api/instance/metrics", self.get_metrics)
         r("GET", r"/api/instance/topics", self.get_topics)

@@ -554,7 +554,10 @@ class SharedScoringPool:
     async def _settle_and_deliver(self, dispatches, metas, t0: float,
                                   seq: Optional[int] = None) -> None:
         loop = asyncio.get_running_loop()
-        from sitewhere_tpu.scoring.stream import result_to_host as to_host
+        from sitewhere_tpu.scoring.stream import (
+            result_to_host as to_host,
+            sparse_take,
+        )
 
         try:
             try:
@@ -578,8 +581,6 @@ class SharedScoringPool:
                 self.scored_meter.mark(n)
                 self.latency.observe_array(now - ing)
                 if sparse:
-                    from sitewhere_tpu.scoring.stream import sparse_take
-
                     # per-tenant anomalous subset: remap round-local
                     # positions back to this tenant's take positions
                     anom_pos: list[np.ndarray] = []

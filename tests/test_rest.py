@@ -792,3 +792,29 @@ def test_rest_device_forecast(run):
             assert status == 404 and "attention" in err["error"]
 
     run(main())
+
+
+def test_openapi_description(run):
+    """GET /api/openapi.json: unauthenticated machine-readable spec
+    covering every installed route, with path params converted and JWT
+    authorities annotated (the reference's Swagger analog)."""
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            status, spec = await http(port, "GET", "/api/openapi.json")
+            assert status == 200
+            assert spec["openapi"].startswith("3.")
+            n_ops = sum(len(v) for v in spec["paths"].values())
+            assert n_ops >= 85, n_ops
+            # regex named groups became {param} path templates
+            tenant = spec["paths"]["/api/tenants/{token}"]["get"]
+            assert tenant["parameters"][0]["name"] == "token"
+            # authorities annotated; auth-free routes carry no security
+            users = spec["paths"]["/api/users"]["get"]
+            assert users["x-authority"] == "ADMINISTER_USERS"
+            assert "security" not in spec["paths"]["/api/jwt"]["post"]
+            # spec covers the whole live route table
+            assert n_ops == len(rt.services["instance-management"]
+                                .rest._routes)
+
+    run(main())
