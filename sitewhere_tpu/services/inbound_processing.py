@@ -17,7 +17,6 @@ import inspect
 import logging
 import time
 
-import numpy as np
 
 from sitewhere_tpu.config import TenantConfig
 from sitewhere_tpu.domain.batch import (
