@@ -271,7 +271,9 @@ async def cmd_simulate(args) -> int:
         kw = {"topic": args.topic, "client_id": args.client_id,
               "username": args.username, "password": args.password}
     elif args.protocol == "coap":
-        kw = {"path": args.topic}
+        # --password doubles as the CoAP ingest shared secret
+        # (Uri-Query token=<secret>, services/coap.py)
+        kw = {"path": args.topic, "secret": args.password}
     elif args.protocol == "websocket":
         kw = {"client_id": args.client_id, "token": args.password}
     elif args.protocol == "amqp":
@@ -508,7 +510,8 @@ def main(argv=None) -> int:
                        help="MQTT/WebSocket client id")
     p_sim.add_argument("--username", help="MQTT/AMQP username")
     p_sim.add_argument("--password",
-                       help="MQTT/AMQP password; WebSocket bearer token")
+                       help="MQTT/AMQP password; WebSocket bearer token; "
+                            "CoAP ingest shared secret")
 
     p_demo = sub.add_parser("demo", parents=[common], help="one-process end-to-end demo")
     p_demo.add_argument("--devices", type=int, default=1000)
