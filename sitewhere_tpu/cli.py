@@ -162,6 +162,24 @@ async def cmd_serve_bus(args) -> int:
     await server.start()
     print(f"swx bus broker on {server.host}:{server.port}"
           + (" (auth required)" if secret else ""), flush=True)
+    kafka_ep = None
+    if args.kafka_port is not None:
+        if secret and args.host not in ("127.0.0.1", "localhost", "::1"):
+            # the Kafka endpoint has no SASL: serving the SAME bus
+            # unauthenticated on a non-loopback interface would silently
+            # bypass the wire secret
+            raise SystemExit(
+                "swx serve-bus: --kafka-port with --secret on a "
+                f"non-loopback host ({args.host}) would expose the bus "
+                "without auth; bind the kafka endpoint to loopback and "
+                "front it with your own gateway/TLS, or drop --secret")
+        from sitewhere_tpu.kernel.kafka_endpoint import KafkaEndpoint
+
+        kafka_ep = KafkaEndpoint(bus, host=args.host,
+                                 port=args.kafka_port)
+        await kafka_ep.start()
+        print(f"swx kafka endpoint on {args.host}:{kafka_ep.port} "
+              f"(UNAUTHENTICATED - trusted networks only)", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -170,6 +188,8 @@ async def cmd_serve_bus(args) -> int:
         except NotImplementedError:  # pragma: no cover
             pass
     await stop.wait()
+    if kafka_ep is not None:
+        await kafka_ep.stop()
     await server.stop()
     await bus.stop()
     return 0
@@ -199,6 +219,14 @@ async def cmd_run(args) -> int:
         or os.environ.get("SWX_WIRE_SECRET")
     bus = None
     if args.bus:
+        if getattr(args, "kafka_port", None) is not None:
+            # arg-level conflict: fail BEFORE any service starts (the
+            # late check would abort with live services + durable
+            # writers never cleanly stopped)
+            raise SystemExit(
+                "swx run: --kafka-port needs the in-proc bus (this "
+                "process attaches to a remote broker via --bus; put "
+                "--kafka-port on the `swx serve-bus` process instead)")
         from sitewhere_tpu.kernel.wire import RemoteEventBus
 
         bus = RemoteEventBus(*_parse_addr(args.bus), secret=wire_secret)
@@ -238,6 +266,16 @@ async def cmd_run(args) -> int:
                                    tuple(tenant.authorized_user_ids))
         else:
             await rt.add_tenant(tenant)
+    kafka_ep = None
+    if getattr(args, "kafka_port", None) is not None:
+        from sitewhere_tpu.kernel.bus import EventBus
+        from sitewhere_tpu.kernel.kafka_endpoint import KafkaEndpoint
+
+        assert isinstance(rt.bus, EventBus)  # enforced at arg parse
+        kafka_ep = KafkaEndpoint(rt.bus, port=args.kafka_port)
+        await kafka_ep.start()
+        print(f"swx kafka endpoint on 127.0.0.1:{kafka_ep.port}",
+              flush=True)
     im_svc = rt.services.get("instance-management")
     rest = im_svc.rest if im_svc is not None else None
     print(f"swx instance {settings.instance_id} up; "
@@ -253,9 +291,24 @@ async def cmd_run(args) -> int:
         except NotImplementedError:  # pragma: no cover
             pass
     await stop.wait()
+    _dbg = os.environ.get("SWX_DEBUG_SHUTDOWN")
+    if _dbg: print("SHUTDOWN: signal received", flush=True)
+    if kafka_ep is not None:
+        await kafka_ep.stop()
+    if _dbg: print("SHUTDOWN: kafka endpoint stopped", flush=True)
     if api_server is not None:
         await api_server.stop()
-    await rt.stop()
+    if _dbg: print("SHUTDOWN: api server stopped", flush=True)
+    if _dbg:
+        from sitewhere_tpu.kernel.lifecycle import LifecycleProgressMonitor
+
+        mon = LifecycleProgressMonitor(
+            on_step=lambda p, step, t: print(
+                f"SHUTDOWN: {p} {step} @{t:.1f}s", flush=True))
+        await rt.stop(mon)
+    else:
+        await rt.stop()
+    if _dbg: print("SHUTDOWN: runtime stopped", flush=True)
     return 0
 
 
@@ -462,6 +515,9 @@ def main(argv=None) -> int:
     p_run = sub.add_parser("run", parents=[common], help="run a full instance (or a subset "
                                        "of services against a wire bus)")
     p_run.add_argument("--config", help="instance YAML")
+    p_run.add_argument("--kafka-port", type=int, default=None,
+                       help="also serve this instance's bus over the "
+                            "Kafka wire protocol (0 = ephemeral)")
     p_run.add_argument("--port", type=int, help="REST port")
     p_run.add_argument("--gateway-port", type=int, default=47800)
     p_run.add_argument("--services",
@@ -486,6 +542,9 @@ def main(argv=None) -> int:
     p_bus.add_argument("--port", type=int, default=47900)
     p_bus.add_argument("--partitions", type=int, default=4)
     p_bus.add_argument("--retention", type=int, default=4096)
+    p_bus.add_argument("--kafka-port", type=int, default=None,
+                       help="also serve the bus over the Kafka wire "
+                            "protocol on this port (0 = ephemeral)")
     p_bus.add_argument("--secret",
                        help="require this shared secret from every wire "
                             "peer (default: SWX_WIRE_SECRET env; unset = "

@@ -107,6 +107,7 @@ class RestServer(LifecycleComponent):
         self.host = host or runtime.settings.rest_host
         self.port = port if port is not None else runtime.settings.rest_port
         self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set[asyncio.StreamWriter] = set()
         self._routes: list[tuple[str, re.Pattern, Callable, Optional[str]]] = []
         self._install_routes()
 
@@ -119,15 +120,19 @@ class RestServer(LifecycleComponent):
         logger.info("REST listening on %s:%d", self.host, self.port)
 
     async def _do_stop(self, monitor) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # a client holding a keep-alive connection (normal HTTP
+        # behavior) must not wedge instance shutdown — found by a
+        # kill/restart drive that held one open
+        from sitewhere_tpu.kernel.net import shutdown_server
+
+        await shutdown_server(self._server, self._writers)
+        self._server = None
 
     # -- http plumbing -----------------------------------------------------
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
         try:
             while True:
                 line = await reader.readline()
@@ -172,6 +177,7 @@ class RestServer(LifecycleComponent):
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._writers.discard(writer)
             try:
                 writer.close()
             except Exception:  # noqa: BLE001

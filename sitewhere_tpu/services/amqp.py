@@ -422,12 +422,7 @@ class AmqpListener:
                 pass
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            # Server.wait_closed waits for live connection HANDLERS too
-            # (3.12 semantics); close them or a connected client that
-            # never hangs up wedges engine shutdown
-            for w in list(self._writers):
-                w.close()
-            await self._server.wait_closed()
-            self._server = None
+        from sitewhere_tpu.kernel.net import shutdown_server
+
+        await shutdown_server(self._server, self._writers)
+        self._server = None

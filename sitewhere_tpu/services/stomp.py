@@ -97,12 +97,10 @@ class StompListener:
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            for w in list(self._writers):   # 3.12: wait_closed waits for
-                w.close()                   # live handlers
-            await self._server.wait_closed()
-            self._server = None
+        from sitewhere_tpu.kernel.net import shutdown_server
+
+        await shutdown_server(self._server, self._writers)
+        self._server = None
 
     # -- frame IO ----------------------------------------------------------
 

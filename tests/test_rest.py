@@ -818,3 +818,28 @@ def test_openapi_description(run):
                                 .rest._routes)
 
     run(main())
+
+
+def test_shutdown_with_live_keepalive_connection(run):
+    """A client holding a keep-alive connection (normal HTTP behavior)
+    must not wedge instance shutdown: 3.12's wait_closed() waits for
+    handlers, so stop() closes tracked client writers first. Found by
+    a kill/restart drive whose instance needed SIGKILL."""
+
+    async def main():
+        rt = ServiceRuntime(InstanceSettings(instance_id="ka",
+                                             rest_port=0))
+        rt.add_service(InstanceManagementService(rt))
+        await rt.start()
+        port = rt.services["instance-management"].rest.port
+        # one full request/response, then HOLD the connection open
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /api/instance/health HTTP/1.1\r\n"
+                     b"Host: x\r\n\r\n")
+        await writer.drain()
+        await reader.readuntil(b"\r\n\r\n")
+        # do NOT close: stop must still finish promptly
+        await asyncio.wait_for(rt.stop(), 10)
+        writer.close()
+
+    run(main())
