@@ -273,9 +273,16 @@ async def cmd_run(args) -> int:
 
         assert isinstance(rt.bus, EventBus)  # enforced at arg parse
         kafka_ep = KafkaEndpoint(rt.bus, port=args.kafka_port)
-        await kafka_ep.start()
-        print(f"swx kafka endpoint on 127.0.0.1:{kafka_ep.port}",
-              flush=True)
+        try:
+            await kafka_ep.start()
+        except OSError as exc:
+            # bind failure AFTER services started: stop cleanly (durable
+            # writers must flush) before failing loudly
+            await rt.stop()
+            raise SystemExit(
+                f"swx run: kafka endpoint bind failed: {exc}") from exc
+        print(f"swx kafka endpoint on 127.0.0.1:{kafka_ep.port} "
+              f"(UNAUTHENTICATED - trusted networks only)", flush=True)
     im_svc = rt.services.get("instance-management")
     rest = im_svc.rest if im_svc is not None else None
     print(f"swx instance {settings.instance_id} up; "

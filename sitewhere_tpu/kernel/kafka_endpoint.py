@@ -255,6 +255,15 @@ class KafkaEndpoint:
 
     async def _dispatch(self, api_key: int, api_version: int,
                         r: _Reader) -> Optional[bytes]:
+        if api_version != 0:
+            if api_key == API_VERSIONS:
+                # error 35 (UNSUPPORTED_VERSION) + the served list: the
+                # standard negotiation path — clients retry with v0
+                return struct.pack(">h", 35) + self._api_versions()[2:]
+            logger.info("kafka endpoint: api %d v%d not served (v0 "
+                        "only); dropping connection", api_key,
+                        api_version)
+            return None
         if api_key == API_VERSIONS:
             return self._api_versions()
         if api_key == API_METADATA:
@@ -383,14 +392,19 @@ class KafkaEndpoint:
                 for i in range(offset - log.base_offset,
                                len(log.records)):
                     key, value, ts = log.records[i]
-                    try:
-                        vb = codec.encode(value)
-                    except Exception:  # noqa: BLE001 - raw bytes pass through
-                        vb = value if isinstance(value, bytes) else None
+                    if isinstance(value, bytes):
+                        vb = value        # foreign bytes verbatim: a
+                        # foreign->foreign round trip must not grow a
+                        # codec prefix a real broker would never add
+                    else:
+                        try:
+                            vb = codec.encode(value)
+                        except Exception:  # noqa: BLE001
+                            vb = None
                     entry = (log.base_offset + i,
                              key.encode() if key is not None else None,
                              vb, int(ts * 1000))
-                    esize = 26 + (len(entry[1]) if entry[1] else 0) + \
+                    esize = 34 + (len(entry[1]) if entry[1] else 0) + \
                         (len(vb) if vb else 0)
                     if entries and size + esize > max(max_bytes, 1):
                         break
@@ -446,7 +460,18 @@ class KafkaEndpoint:
                         + _arr([]))
                     continue
                 log = topic.partitions[pid]
-                off = log.base_offset if ts == -2 else log.end_offset
+                if ts == -2:
+                    off = log.base_offset
+                elif ts == -1:
+                    off = log.end_offset
+                else:
+                    # offsetsForTimes: first retained record at/after
+                    # the wall-clock point (record ts are epoch seconds)
+                    off = log.end_offset
+                    for i, (_k, _v, rts) in enumerate(log.records):
+                        if rts * 1000 >= ts:
+                            off = log.base_offset + i
+                            break
                 parts_out.append(struct.pack(">ih", pid, ERR_NONE)
                                  + _arr([struct.pack(">q", off)]
                                         [:max(max_n, 1)]))
@@ -473,8 +498,9 @@ class KafkaEndpoint:
                 pid = r.i32()
                 offset = r.i64()
                 r.string()  # metadata
-                # monotonic, like BusConsumer.commit
-                prev = state.committed.get((name, pid), 0)
+                # monotonic, like BusConsumer.commit (-1 default so a
+                # legitimate commit of offset 0 is stored, not dropped)
+                prev = state.committed.get((name, pid), -1)
                 if offset > prev:
                     state.committed[(name, pid)] = offset
                 parts_out.append(struct.pack(">ih", pid, ERR_NONE))

@@ -3,8 +3,8 @@
 Python 3.12's `Server.wait_closed()` waits for live connection HANDLERS,
 so every TCP listener must close its tracked client writers at stop or a
 peer holding a connection open (normal keep-alive behavior) wedges
-shutdown. Five listeners carry that pattern (REST, Kafka, STOMP, AMQP,
-WebSocket); this helper owns it once — including the accept/stop race: a
+shutdown. All seven listeners use this helper (REST, Kafka, STOMP, AMQP,
+WebSocket, MQTT, TCP gateway) — including the accept/stop race: a
 handler task created just before `close()` hasn't registered its writer
 yet, so we yield and re-close for a few passes to catch late joiners.
 """

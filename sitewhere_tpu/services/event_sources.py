@@ -247,10 +247,12 @@ class TcpEventReceiver(BackgroundTaskComponent):
 
     async def _do_stop(self, monitor) -> None:
         await super()._do_stop(monitor)
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        from sitewhere_tpu.kernel.net import shutdown_server
+
+        # a connected gateway that never hangs up must not wedge the
+        # tenant engine's shutdown (3.12 wait_closed semantics)
+        await shutdown_server(self._server, self._conns)
+        self._server = None
 
 
 class MqttEventReceiver(BackgroundTaskComponent):

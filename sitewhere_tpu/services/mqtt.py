@@ -127,16 +127,12 @@ class MqttListener:
         # close live client connections BEFORE wait_closed: since 3.12,
         # Server.wait_closed() waits for handlers, and handlers block in
         # readexactly until their peer socket dies
-        if self._server is not None:
-            self._server.close()
-        for w in list(self._conns):
-            try:
-                w.close()
-            except RuntimeError:
-                pass
+        from sitewhere_tpu.kernel.net import shutdown_server
+
         if self._server is not None:
             try:
-                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+                await asyncio.wait_for(
+                    shutdown_server(self._server, self._conns), 5.0)
             except asyncio.TimeoutError:
                 logger.warning("mqtt: listener handlers did not drain in 5s")
             self._server = None

@@ -85,16 +85,12 @@ class WebSocketListener:
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-        for w in list(self._conns):
-            try:
-                w.close()
-            except RuntimeError:
-                pass
+        from sitewhere_tpu.kernel.net import shutdown_server
+
         if self._server is not None:
             try:
-                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+                await asyncio.wait_for(
+                    shutdown_server(self._server, self._conns), 5.0)
             except asyncio.TimeoutError:
                 logger.warning("ws: handlers did not drain in 5s")
             self._server = None

@@ -394,3 +394,86 @@ def test_stop_interrupts_long_poll(run):
             await bus.stop()
 
     run(main())
+
+
+def test_hostile_codec_bytes_fall_back_to_raw(run):
+    """The endpoint codec-decodes UNAUTHENTICATED foreign bytes; crafted
+    payloads (truncated frames, huge claimed lengths, deep nesting,
+    unregistered classes) must neither crash the endpoint nor allocate
+    past the payload — they land as raw bytes."""
+    async def main():
+        bus, ep, client = await _setup()
+        try:
+            hostile = [
+                codec.encode([1, 2, 3])[:5],            # truncated
+                b"\x07" + (2**31 - 1).to_bytes(4, "big"),  # huge list len
+                b"\x07\x00\x00\x00\x01" * 400,          # deep nesting
+                b"\x0b" + b"\x00\x00\x00\x05Ghost"      # unregistered
+                + b"\x00\x00\x00\x00",
+            ]
+            for i, payload in enumerate(hostile):
+                err, _ = await client.produce("h", 0, [(None, payload)])
+                assert err == 0, i
+            consumer = bus.subscribe("h", group="hg")
+            got = []
+            for _ in range(50):
+                got += [r.value for r in
+                        await consumer.poll(max_records=8, timeout=0.1)]
+                if len(got) == len(hostile):
+                    break
+            assert all(isinstance(v, bytes) for v in got), got
+            assert got == hostile
+            consumer.close()
+        finally:
+            await client.close()
+            await ep.stop()
+            await bus.stop()
+
+    run(main())
+
+
+def test_protocol_edges(run):
+    """v>0 negotiation (error 35 on ApiVersions; other APIs dropped),
+    foreign->foreign byte fidelity (no codec prefix added), timestamp
+    ListOffsets, and offset-0 commits sticking."""
+    async def main():
+        bus, ep, client = await _setup()
+        try:
+            # ApiVersions at v3 -> error 35 + the served list (the
+            # standard fallback path; the client retries with v0)
+            client._corr += 1
+            req = (struct.pack(">hhi", 18, 3, client._corr)
+                   + _s("c") + b"")
+            client.writer.write(struct.pack(">i", len(req)) + req)
+            await client.writer.drain()
+            size = struct.unpack(">i",
+                                 await client.reader.readexactly(4))[0]
+            payload = await client.reader.readexactly(size)
+            err = struct.unpack_from(">h", payload, 4)[0]
+            assert err == 35
+
+            # foreign bytes fetch back VERBATIM (no codec prefix)
+            err, _ = await client.produce("ff", 0, [(None, b"raw-json")])
+            assert err == 0
+            err, hwm, msgs = await client.fetch("ff", 0, 0)
+            assert msgs[0][1] == b"raw-json"
+
+            # timestamp ListOffsets: first record at/after the point
+            # (bus stamps wall-clock seconds at produce)
+            import time as _time
+
+            t_mid = (_time.time() + 0.0005) * 1000
+            await asyncio.sleep(0.01)
+            await bus.produce("ff", "later", partition=0)
+            err, offs = await client.list_offsets("ff", 0, int(t_mid))
+            assert err == 0 and offs == [1]
+
+            # offset-0 commit sticks (prev default must be -1, not 0)
+            await client.offset_commit("gz", "ff", 0, 0)
+            assert await client.offset_fetch("gz", "ff", 0) == 0
+        finally:
+            await client.close()
+            await ep.stop()
+            await bus.stop()
+
+    run(main())

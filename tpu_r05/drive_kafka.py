@@ -121,8 +121,9 @@ async def main():
         st, metrics = await http(PORT, "GET", "/api/instance/metrics",
                                  token=tok, tenant="default")
         assert st == 200
-        persisted = metrics.get("event_management.events_persisted", {})
-        print("VERIFY-KAFKA-OK persisted:", persisted.get("count"))
+        rate = metrics.get("event_management.events_persisted", {})
+        print("VERIFY-KAFKA-OK persist rate_60s:",
+              rate.get("rate_60s") if isinstance(rate, dict) else rate)
     finally:
         proc.terminate()
         import threading
