@@ -723,14 +723,39 @@ async def run_bench(args) -> dict:
     if args.durable:
         # fresh dir per run: a restored registry would collide with
         # bootstrap_fleet's tokens (and a replayed log would contaminate
-        # the measurement — the bench measures spill cost, not recovery)
+        # the measurement — the bench measures spill cost, not recovery).
+        # Never silently destroy a directory this run didn't create:
+        # pointing --durable at a live data dir requires --force.
         import shutil
 
+        if os.path.isdir(args.durable) and os.listdir(args.durable) \
+                and not args.force_wipe:
+            raise RuntimeError(
+                f"--durable {args.durable!r} exists and is not empty; "
+                "the bench wipes its durable dir before each run — "
+                "pass --force-wipe to confirm, or point it somewhere "
+                "fresh")
         shutil.rmtree(args.durable, ignore_errors=True)
         os.makedirs(args.durable, exist_ok=True)
     rt = ServiceRuntime(InstanceSettings(
         instance_id="bench", engine_ready_timeout_s=args.ready_timeout,
         data_dir=args.durable))
+    fi = None
+    if args.chaos:
+        # chaos mode: deterministic fault injection at three layers —
+        # consumer polls (crashes loops -> supervisor restarts them),
+        # scoring dispatch (crashes the rule loop BEFORE pending
+        # admissions are taken, so nothing is dropped), and the durable
+        # spill writer (with --durable). Injections are bounded per
+        # site so the restart budget (5/60s) is never exceeded by
+        # design; the artifact proves the pipeline drained through them.
+        from sitewhere_tpu.kernel.faults import FaultInjector
+
+        fi = rt.install_faults(FaultInjector(seed=args.chaos_seed))
+        fi.arm("bus.poll", rate=0.002, max_faults=args.chaos_faults)
+        fi.arm("scoring.dispatch", rate=0.01, max_faults=args.chaos_faults)
+        if args.durable:
+            fi.arm("durable.flush", rate=0.05, max_faults=args.chaos_faults)
     for cls in (DeviceManagementService, EventSourcesService,
                 InboundProcessingService, EventManagementService,
                 DeviceStateService, RuleProcessingService):
@@ -949,6 +974,14 @@ async def run_bench(args) -> dict:
         spill = {"written": sum(d.written for d in logs if d),
                  "dropped": sum(d.dropped for d in logs if d)}
 
+    chaos = None
+    if fi is not None:
+        restarts = rt.metrics.counter("supervisor.restarts").value
+        dlq = rt.metrics.counter("dlq.quarantined").value
+        chaos = {"seed": args.chaos_seed, "sites": fi.snapshot(),
+                 "supervisor_restarts": int(restarts),
+                 "dead_letters": int(dlq)}
+
     await rt.stop()
 
     return {
@@ -1000,6 +1033,7 @@ async def run_bench(args) -> dict:
                      else "full"),
         "durable": bool(args.durable),
         "durable_spill": spill,
+        "chaos": chaos,
         "chips": n_chips,
         "device_kind": device_kind,
         "platform": platform,
@@ -1081,6 +1115,24 @@ def main() -> None:
                              "spill + registry snapshots) rooted at DIR; "
                              "measures the spill tax vs the RAM-only "
                              "default")
+    # named --force-wipe, not --force: a bare `--force` used to resolve
+    # as the unique abbreviation of --force-cpu, and repurposing it
+    # would silently both unpin CPU and arm the destructive wipe
+    parser.add_argument("--force-wipe", action="store_true",
+                        help="allow --durable to wipe an existing "
+                             "non-empty directory")
+    parser.add_argument("--chaos", action="store_true",
+                        help="inject deterministic faults (bus polls, "
+                             "scoring dispatch, durable flush) during "
+                             "the run to prove the supervisor + DLQ "
+                             "keep the pipeline draining; counters land "
+                             "in the artifact's 'chaos' field")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="fault-injector seed (per-site deterministic)")
+    parser.add_argument("--chaos-faults", type=int, default=4,
+                        help="max injected faults per site (bounded so "
+                             "the 5/60s restart budget is never exceeded "
+                             "by design)")
     parser.add_argument("--force-cpu", action="store_true",
                         help="run on the CPU backend (the supervisor uses "
                              "this when the accelerator is unreachable)")

@@ -7,6 +7,7 @@ rebuild ships one:
   swx simulate --host H --port P --devices N       stream SWB1 at a gateway
   swx bench [...]                                  run the benchmark
   swx demo                                         run + simulate + score, one process
+  swx dlq list|replay --tenant T                   inspect/replay dead letters
 
 `run` starts every service, creates tenants from the YAML (or a default
 tenant), and serves REST until interrupted.
@@ -176,7 +177,8 @@ async def cmd_serve_bus(args) -> int:
         from sitewhere_tpu.kernel.kafka_endpoint import KafkaEndpoint
 
         kafka_ep = KafkaEndpoint(bus, host=args.host,
-                                 port=args.kafka_port)
+                                 port=args.kafka_port,
+                                 auto_create_limit=args.kafka_auto_topics)
         await kafka_ep.start()
         print(f"swx kafka endpoint on {args.host}:{kafka_ep.port} "
               f"(UNAUTHENTICATED - trusted networks only)", flush=True)
@@ -272,7 +274,8 @@ async def cmd_run(args) -> int:
         from sitewhere_tpu.kernel.kafka_endpoint import KafkaEndpoint
 
         assert isinstance(rt.bus, EventBus)  # enforced at arg parse
-        kafka_ep = KafkaEndpoint(rt.bus, port=args.kafka_port)
+        kafka_ep = KafkaEndpoint(rt.bus, port=args.kafka_port,
+                                 auto_create_limit=args.kafka_auto_topics)
         try:
             await kafka_ep.start()
         except OSError as exc:
@@ -316,6 +319,88 @@ async def cmd_run(args) -> int:
     else:
         await rt.stop()
     if _dbg: print("SHUTDOWN: runtime stopped", flush=True)
+    return 0
+
+
+async def _http_json(method: str, host: str, port: int, path: str,
+                     headers: dict | None = None, body: dict | None = None,
+                     timeout_s: float = 10.0) -> tuple[int, object]:
+    """Tiny one-shot HTTP/1.1 JSON request (the dlq subcommand's
+    client; utils/http.py only ships POST-for-connectors)."""
+
+    async def attempt():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            payload = json.dumps(body).encode() if body is not None else b""
+            head = [f"{method} {path} HTTP/1.1", f"Host: {host}",
+                    "Connection: close", f"Content-Length: {len(payload)}"]
+            if body is not None:
+                head.append("Content-Type: application/json")
+            for k, v in (headers or {}).items():
+                head.append(f"{k}: {v}")
+            writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            resp_headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                resp_headers[k.strip().lower()] = v.strip()
+            # the server keeps connections alive: read exactly the body,
+            # never to EOF
+            length = int(resp_headers.get("content-length", 0) or 0)
+            data = await reader.readexactly(length) if length else b""
+            return status, (json.loads(data) if data else None)
+        finally:
+            writer.close()
+
+    return await asyncio.wait_for(attempt(), timeout_s)
+
+
+async def cmd_dlq(args) -> int:
+    """List/replay a tenant's dead-letter quarantine over the REST API
+    (`swx dlq list` / `swx dlq replay`)."""
+    import base64
+
+    basic = base64.b64encode(
+        f"{args.user}:{args.password}".encode()).decode()
+    try:
+        return await _dlq_request(args, basic)
+    except (OSError, asyncio.TimeoutError, IndexError, ValueError) as exc:
+        # unreachable/unresponsive server must not print a raw traceback
+        print(f"swx dlq: cannot reach REST at {args.host}:{args.port}: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return 1
+
+
+async def _dlq_request(args, basic: str) -> int:
+    status, out = await _http_json(
+        "POST", args.host, args.port, "/api/jwt",
+        headers={"Authorization": f"Basic {basic}"})
+    if status != 200:
+        print(f"swx dlq: authentication failed ({status}): {out}",
+              file=sys.stderr)
+        return 1
+    headers = {"Authorization": f"Bearer {out['token']}",
+               "X-SiteWhere-Tenant": args.tenant}
+    if args.action == "list":
+        status, out = await _http_json(
+            "GET", args.host, args.port, f"/api/dlq?limit={args.limit}",
+            headers=headers)
+    else:  # replay
+        # always send the explicit limit: `--limit 0` must be a no-op,
+        # not an accidental replay-everything
+        status, out = await _http_json(
+            "POST", args.host, args.port, "/api/dlq/replay",
+            headers=headers, body={"limit": args.limit})
+    if status != 200:
+        print(f"swx dlq: {args.action} failed ({status}): {out}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2))
     return 0
 
 
@@ -525,6 +610,10 @@ def main(argv=None) -> int:
     p_run.add_argument("--kafka-port", type=int, default=None,
                        help="also serve this instance's bus over the "
                             "Kafka wire protocol (0 = ephemeral)")
+    p_run.add_argument("--kafka-auto-topics", type=int, default=256,
+                       help="max topics the (unauthenticated) kafka "
+                            "endpoint may auto-create for clients "
+                            "(0 = none; existing topics always served)")
     p_run.add_argument("--port", type=int, help="REST port")
     p_run.add_argument("--gateway-port", type=int, default=47800)
     p_run.add_argument("--services",
@@ -552,6 +641,10 @@ def main(argv=None) -> int:
     p_bus.add_argument("--kafka-port", type=int, default=None,
                        help="also serve the bus over the Kafka wire "
                             "protocol on this port (0 = ephemeral)")
+    p_bus.add_argument("--kafka-auto-topics", type=int, default=256,
+                       help="max topics the (unauthenticated) kafka "
+                            "endpoint may auto-create for clients "
+                            "(0 = none; existing topics always served)")
     p_bus.add_argument("--secret",
                        help="require this shared secret from every wire "
                             "peer (default: SWX_WIRE_SECRET env; unset = "
@@ -578,6 +671,18 @@ def main(argv=None) -> int:
     p_sim.add_argument("--password",
                        help="MQTT/AMQP password; WebSocket bearer token; "
                             "CoAP ingest shared secret")
+
+    p_dlq = sub.add_parser("dlq", parents=[common],
+                           help="list/replay a tenant's dead-letter "
+                                "quarantine via the REST API")
+    p_dlq.add_argument("action", choices=["list", "replay"])
+    p_dlq.add_argument("--host", default="127.0.0.1")
+    p_dlq.add_argument("--port", type=int, default=8080, help="REST port")
+    p_dlq.add_argument("--tenant", default="default")
+    p_dlq.add_argument("--limit", type=int, default=100,
+                       help="max dead letters to list/replay")
+    p_dlq.add_argument("--user", default="admin")
+    p_dlq.add_argument("--password", default="password")
 
     p_demo = sub.add_parser("demo", parents=[common], help="one-process end-to-end demo")
     p_demo.add_argument("--devices", type=int, default=1000)
@@ -623,7 +728,8 @@ def main(argv=None) -> int:
 
             jax.config.update("jax_platforms", "cpu")
     coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
-            "train": cmd_train, "serve-bus": cmd_serve_bus}[args.cmd]
+            "train": cmd_train, "serve-bus": cmd_serve_bus,
+            "dlq": cmd_dlq}[args.cmd]
     return asyncio.run(coro(args))
 
 

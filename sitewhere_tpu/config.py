@@ -41,6 +41,15 @@ class InstanceSettings:
     # engine spin-up bound: first TPU compiles over a tunneled chip can
     # take minutes — the old 60 s default killed whole bench runs
     engine_ready_timeout_s: float = 300.0
+    # supervision (kernel/lifecycle.py SupervisorPolicy): a crashed
+    # service loop restarts with exponential backoff, at most
+    # `supervisor_max_restarts` times per `supervisor_window_s` sliding
+    # window; past the budget the component goes LIFECYCLE_ERROR.
+    # max_restarts=0 disables supervision (first crash is fatal).
+    supervisor_max_restarts: int = 5
+    supervisor_window_s: float = 60.0
+    supervisor_base_backoff_s: float = 0.05
+    supervisor_max_backoff_s: float = 5.0
     # durability root (persistence/durable.py): when set, event history
     # spills to <data_dir>/tenants/<tenant>/events/ and the device
     # registry snapshots to <data_dir>/tenants/<tenant>/registry.snap;

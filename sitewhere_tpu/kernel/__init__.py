@@ -7,11 +7,15 @@ cross-service traffic rides the topic bus (Kafka semantics, in-proc impl).
 """
 
 from sitewhere_tpu.kernel.lifecycle import (
+    BackgroundTaskComponent,
     LifecycleComponent,
     LifecycleException,
     LifecycleProgressMonitor,
     LifecycleStatus,
+    SupervisedTaskComponent,
+    SupervisorPolicy,
 )
+from sitewhere_tpu.kernel.faults import FaultInjected, FaultInjector
 from sitewhere_tpu.kernel.bus import EventBus, BusConsumer, TopicRecord
 from sitewhere_tpu.kernel.service import (
     Service,
@@ -21,10 +25,15 @@ from sitewhere_tpu.kernel.service import (
 )
 
 __all__ = [
+    "BackgroundTaskComponent",
     "LifecycleComponent",
     "LifecycleException",
     "LifecycleProgressMonitor",
     "LifecycleStatus",
+    "SupervisedTaskComponent",
+    "SupervisorPolicy",
+    "FaultInjected",
+    "FaultInjector",
     "EventBus",
     "BusConsumer",
     "TopicRecord",

@@ -129,6 +129,9 @@ class EventBus(LifecycleComponent):
         self._default_partitions = default_partitions
         self._retention = retention
         self._rr = itertools.count()  # round-robin for keyless produce
+        # chaos seam (kernel/faults.py): None in production — produce/
+        # poll consult the armed sites only when an injector is installed
+        self.faults = None
 
     # -- admin -------------------------------------------------------------
 
@@ -146,6 +149,23 @@ class EventBus(LifecycleComponent):
         self.create_topic(topic)
         return [p.end_offset for p in self._topics[topic].partitions]
 
+    def peek(self, topic: str, *, limit: int = 100) -> list[TopicRecord]:
+        """Admin read: the newest `limit` retained records of `topic`
+        across partitions, oldest-first, without joining any consumer
+        group (the DLQ listing surface — no offsets move)."""
+        t = self._topics.get(topic)
+        if t is None:
+            return []
+        out: list[TopicRecord] = []
+        for p, log in enumerate(t.partitions):
+            for i, (key, value, ts) in enumerate(log.records):
+                out.append(TopicRecord(topic, p, log.base_offset + i,
+                                       key, value, ts))
+        out.sort(key=lambda r: r.timestamp)
+        if limit < 0:
+            return out
+        return out[-limit:] if limit else []  # out[-0:] would be ALL
+
     # -- produce -----------------------------------------------------------
 
     def _select_partition(self, topic: _Topic, key: Optional[str]) -> int:
@@ -158,6 +178,8 @@ class EventBus(LifecycleComponent):
                       key: Optional[str] = None,
                       partition: Optional[int] = None) -> tuple[int, int]:
         """Append a record; returns (partition, offset)."""
+        if self.faults is not None:
+            await self.faults.acheck("bus.produce")
         self.create_topic(topic_name)
         topic = self._topics[topic_name]
         p = partition if partition is not None else self._select_partition(topic, key)
@@ -271,6 +293,11 @@ class BusConsumer:
 
     def poll_nowait(self, max_records: int = 512) -> list[TopicRecord]:
         """Drain available records without waiting."""
+        if self._bus.faults is not None:
+            # chaos site: a fault here crashes the consuming service
+            # loop BEFORE any position advances — the supervisor
+            # restarts it and uncommitted records redeliver
+            self._bus.faults.check("bus.poll")
         out: list[TopicRecord] = []
         for tp in self._assignment:
             if len(out) >= max_records:
@@ -395,6 +422,7 @@ class TopicNaming:
     UNDELIVERED_COMMANDS = "undelivered-command-invocations"
     BATCH_ELEMENTS = "batch-operation-elements"
     SCORED_EVENTS = "scored-events"              # new: model-plane output
+    DEAD_LETTER = "dead-letter-events"           # poison-record quarantine
     # instance-scoped
     TENANT_MODEL_UPDATES = "tenant-model-updates"
     INSTANCE_LOGS = "instance-logs"

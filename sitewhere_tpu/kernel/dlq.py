@@ -1,0 +1,114 @@
+"""Dead-letter quarantine for poison records.
+
+The platform promise (PAPER.md §1): one misbehaving device never takes
+down a tenant's pipeline. Before this module, a record whose handler
+raised killed the whole consuming loop; now every bus poll loop wraps
+per-record handling and routes the failing record here instead —
+processing continues and the offset commits PAST the poison record.
+
+A dead letter is a plain dict on the per-tenant
+`TopicNaming.DEAD_LETTER` topic, carrying full provenance:
+
+    {"original_topic": ..., "partition": ..., "offset": ...,
+     "key": ..., "value": <the original record value>,
+     "stage": <component path that failed>,
+     "error": "ValueError: ...", "quarantined_at": epoch_s}
+
+Replay re-produces the original value onto its original topic (same
+key, so partition affinity holds) and commits the replay group's
+offset past it, so repeated replays never duplicate. A record that is
+still poisonous simply returns to the DLQ with a fresh offset.
+
+Surfaces: REST `GET /api/dlq` + `POST /api/dlq/replay` (rest/api.py)
+and `swx dlq list|replay` (cli.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# error summaries ride the bus and REST responses — bound them
+_ERR_MAX = 500
+
+
+def summarize_error(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: {exc}"[:_ERR_MAX]
+
+
+async def quarantine(bus, dlq_topic: str, record, exc: BaseException,
+                     stage: str, metrics=None,
+                     tenant_id: Optional[str] = None) -> None:
+    """Publish a poison record to the tenant's dead-letter topic.
+
+    Never raises: a DLQ publish failure is logged and counted — the
+    consuming loop must keep draining either way."""
+    entry = {
+        "original_topic": record.topic,
+        "partition": record.partition,
+        "offset": record.offset,
+        "key": record.key,
+        "value": record.value,
+        "stage": stage,
+        "error": summarize_error(exc),
+        "quarantined_at": time.time(),
+    }
+    try:
+        await bus.produce(dlq_topic, entry, key=record.key)
+    except Exception:  # noqa: BLE001 - quarantine must not re-poison the loop
+        logger.exception("dead-letter publish to %s failed for %s@%d",
+                         dlq_topic, record.topic, record.offset)
+        if metrics is not None:
+            metrics.counter("dlq.publish_failures").inc()
+        return
+    logger.warning("%s: quarantined poison record %s[%d]@%d to %s (%s)",
+                   stage, record.topic, record.partition, record.offset,
+                   dlq_topic, entry["error"])
+    if metrics is not None:
+        metrics.counter("dlq.quarantined").inc()
+        if tenant_id:
+            metrics.counter(f"dlq.quarantined:{tenant_id}").inc()
+
+
+def list_dead_letters(bus, dlq_topic: str, limit: int = 100) -> list:
+    """Newest `limit` dead letters as (TopicRecord, entry-dict) pairs.
+
+    Needs the in-proc bus (direct log peek); callers on a wire bus get
+    an AttributeError they should surface as 'not supported here'."""
+    return [(r, r.value) for r in bus.peek(dlq_topic, limit=limit)
+            if isinstance(r.value, dict) and "original_topic" in r.value]
+
+
+async def replay_dead_letters(bus, dlq_topic: str, *,
+                              limit: Optional[int] = None,
+                              metrics=None) -> int:
+    """Re-produce dead letters onto their original topics; returns the
+    count replayed. Progress is committed under a per-topic replay
+    group, so a second replay call continues where the last stopped."""
+    consumer = bus.subscribe(dlq_topic, group=f"{dlq_topic}.replay")
+    replayed = 0
+    try:
+        while limit is None or replayed < limit:
+            # one record per poll, committed immediately after its
+            # re-produce: a produce failure mid-replay must not leave
+            # already-replayed records uncommitted (the next replay call
+            # would re-produce them — the duplicate this group exists
+            # to prevent)
+            records = consumer.poll_nowait(max_records=1)
+            if not records:
+                break
+            entry = records[0].value
+            if isinstance(entry, dict) and "original_topic" in entry:
+                await bus.produce(entry["original_topic"], entry["value"],
+                                  key=entry.get("key"))
+                replayed += 1
+            # else: foreign record on the DLQ topic — skip, still commit
+            consumer.commit()
+    finally:
+        consumer.close()
+    if replayed and metrics is not None:
+        metrics.counter("dlq.replayed").inc(replayed)
+    return replayed

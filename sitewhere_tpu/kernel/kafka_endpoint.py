@@ -182,10 +182,17 @@ class KafkaEndpoint:
     `EventBus` (kernel/bus.py)."""
 
     def __init__(self, bus, host: str = "127.0.0.1", port: int = 0,
-                 node_id: int = 0):
+                 node_id: int = 0, auto_create_limit: int = 256):
         self.bus = bus
         self.host, self.port = host, port
         self.node_id = node_id
+        # unauthenticated peers may request arbitrary topic names; cap
+        # how many NEW topics this endpoint will create on their behalf
+        # (0 = no auto-create at all) so a typo'd or hostile client
+        # can't grow the bus topic map without bound. Topics the
+        # in-proc services created are always served.
+        self.auto_create_limit = auto_create_limit
+        self._auto_created: set[str] = set()
         self.malformed = 0
         self.produced = 0
         self.fetched = 0
@@ -298,6 +305,19 @@ class KafkaEndpoint:
         return (struct.pack(">i", self.node_id) + _s(self.host)
                 + struct.pack(">i", self.port))
 
+    def _topic(self, name: str):
+        """Resolve (auto-creating under the cap) a topic; None when the
+        topic does not exist and the auto-create budget is spent — the
+        caller answers UNKNOWN_TOPIC_OR_PARTITION."""
+        t = self.bus._topics.get(name)
+        if t is not None:
+            return t
+        if len(self._auto_created) >= self.auto_create_limit:
+            return None
+        self._auto_created.add(name)
+        self.bus.create_topic(name)
+        return self.bus._topics[name]
+
     def _metadata(self, r: _Reader) -> bytes:
         n = r.array()
         names = [r.string() for _ in range(n)] or self.bus.topic_names()
@@ -305,8 +325,13 @@ class KafkaEndpoint:
         for name in names:
             if not name:
                 continue
-            self.bus.create_topic(name)   # auto-create, like the bus
-            parts = self.bus._topics[name].partitions
+            topic = self._topic(name)   # auto-create, capped
+            if topic is None:
+                topics.append(struct.pack(
+                    ">h", ERR_UNKNOWN_TOPIC_OR_PARTITION)
+                    + _s(name) + _arr([]))
+                continue
+            parts = topic.partitions
             topics.append(struct.pack(">h", ERR_NONE) + _s(name) + _arr([
                 struct.pack(">hii", ERR_NONE, p, self.node_id)
                 + _arr([struct.pack(">i", self.node_id)])     # replicas
@@ -326,8 +351,11 @@ class KafkaEndpoint:
             for _ in range(r.array()):
                 pid = r.i32()
                 mset = r.bytes_() or b""
-                self.bus.create_topic(name)
-                topic = self.bus._topics[name]
+                topic = self._topic(name)
+                if topic is None:
+                    parts_out.append(struct.pack(
+                        ">ihq", pid, ERR_UNKNOWN_TOPIC_OR_PARTITION, -1))
+                    continue
                 if pid < 0 or pid >= len(topic.partitions):
                     parts_out.append(struct.pack(
                         ">ihq", pid, ERR_UNKNOWN_TOPIC_OR_PARTITION, -1))
@@ -374,9 +402,9 @@ class KafkaEndpoint:
             by_topic: dict[str, list[bytes]] = {}
             total = 0
             for name, pid, offset, max_bytes in wants:
-                self.bus.create_topic(name)
-                topic = self.bus._topics[name]
-                if pid < 0 or pid >= len(topic.partitions):
+                topic = self._topic(name)
+                if topic is None or pid < 0 \
+                        or pid >= len(topic.partitions):
                     by_topic.setdefault(name, []).append(struct.pack(
                         ">ihq", pid, ERR_UNKNOWN_TOPIC_OR_PARTITION, -1)
                         + _b(b""))
@@ -452,9 +480,9 @@ class KafkaEndpoint:
             parts_out = []
             for _ in range(r.array()):
                 pid, ts, max_n = r.i32(), r.i64(), r.i32()
-                self.bus.create_topic(name)
-                topic = self.bus._topics[name]
-                if pid < 0 or pid >= len(topic.partitions):
+                topic = self._topic(name)
+                if topic is None or pid < 0 \
+                        or pid >= len(topic.partitions):
                     parts_out.append(struct.pack(
                         ">ih", pid, ERR_UNKNOWN_TOPIC_OR_PARTITION)
                         + _arr([]))
@@ -472,9 +500,11 @@ class KafkaEndpoint:
                         if rts * 1000 >= ts:
                             off = log.base_offset + i
                             break
+                # max_num_offsets=0 legitimately asks for an empty
+                # offsets array (real brokers honor it)
                 parts_out.append(struct.pack(">ih", pid, ERR_NONE)
                                  + _arr([struct.pack(">q", off)]
-                                        [:max(max_n, 1)]))
+                                        [:max(max_n, 0)]))
             topics_out.append(_s(name) + _arr(parts_out))
         return _arr(topics_out)
 

@@ -20,6 +20,7 @@ import enum
 import logging
 import time
 import traceback
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
@@ -214,9 +215,14 @@ class LifecycleComponent:
 
     def _record_error(self, exc: BaseException, status: LifecycleStatus) -> None:
         self.error = exc
-        self.error_trace = traceback.format_exc()
+        # format the RECORDED exception, not "the currently handled
+        # one": callers outside an except block (the supervisor's
+        # done-callback) would otherwise store 'NoneType: None'
+        self.error_trace = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
         self.status = status
-        logger.error("%s entered %s: %s", self.path, status.value, exc)
+        logger.error("%s entered %s: %s", self.path, status.value, exc,
+                     exc_info=(type(exc), exc, exc.__traceback__))
 
     # -- introspection -----------------------------------------------------
 
@@ -233,34 +239,155 @@ class LifecycleComponent:
         return f"<{type(self).__name__} {self.path} {self.status.value}>"
 
 
+@dataclass(frozen=True)
+class SupervisorPolicy:
+    """Restart policy for a supervised background loop.
+
+    A crashed loop is restarted with exponential backoff as long as the
+    restart budget holds: at most `max_restarts` crashes within the
+    sliding `window_s` window. One crash past the budget moves the
+    component to LIFECYCLE_ERROR — a permanently failing loop must
+    surface in health, not flap forever. `max_restarts=0` disables
+    supervision (first crash is fatal, the pre-supervision behavior).
+    """
+
+    max_restarts: int = 5
+    window_s: float = 60.0
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 5.0
+
+    def backoff(self, crash_n: int) -> float:
+        """Delay before restart number `crash_n` (1-based)."""
+        return min(self.base_backoff_s * (2.0 ** max(crash_n - 1, 0)),
+                   self.max_backoff_s)
+
+
 class BackgroundTaskComponent(LifecycleComponent):
     """A lifecycle component that owns an asyncio task while STARTED.
 
     Many services are 'a poll loop with a lifecycle' (reference: Kafka
     consumer wrappers, [SURVEY.md §2.1 "Kafka integration"]); this base
     manages task spawn/cancel so subclasses only write `_run()`.
+
+    Supervision: a crash in `_run()` no longer kills the loop for the
+    life of the process (the reference's k8s restarts a crashed
+    microservice pod; in-proc loops need the same story). The loop is
+    respawned with exponential backoff under a bounded restart budget
+    (`SupervisorPolicy`); past the budget the component transitions to
+    LIFECYCLE_ERROR, visible in `state_tree()` / the REST health
+    endpoint, and the `supervisor.restarts` counters (total and
+    per-component-path) record every respawn.
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str,
+                 supervisor: Optional[SupervisorPolicy] = None):
         super().__init__(name)
         self._task: Optional[asyncio.Task] = None
+        self._restart_task: Optional[asyncio.Task] = None
+        # None = resolve from the runtime's settings at first crash
+        # (so instance-level knobs apply without threading them through
+        # every service constructor); explicit policy wins.
+        self._supervisor = supervisor
+        self._crash_times: list[float] = []
+        self.restart_count = 0
+        self.last_crash: Optional[BaseException] = None
 
     async def _run(self) -> None:  # pragma: no cover - override
         raise NotImplementedError
 
     async def _do_start(self, monitor: LifecycleProgressMonitor) -> None:
+        # a fresh start (including an operator restart out of
+        # LIFECYCLE_ERROR) begins with a clean restart budget
+        self._crash_times.clear()
+        self._spawn()
+
+    def _spawn(self) -> None:
         self._task = asyncio.create_task(self._run(), name=self.path)
         self._task.add_done_callback(self._on_task_done)
+
+    def _root(self):
+        """Top of the lifecycle tree this component hangs off. Tenant
+        engines are dict-managed (not lifecycle children), so their
+        subtree root exposes `.runtime` — follow it to the actual
+        ServiceRuntime for settings/metrics resolution."""
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        return getattr(root, "runtime", root)
+
+    def _policy(self) -> SupervisorPolicy:
+        if self._supervisor is not None:
+            return self._supervisor
+        settings = getattr(self._root(), "settings", None)
+        if settings is not None and hasattr(settings,
+                                            "supervisor_max_restarts"):
+            self._supervisor = SupervisorPolicy(
+                max_restarts=settings.supervisor_max_restarts,
+                window_s=settings.supervisor_window_s,
+                base_backoff_s=settings.supervisor_base_backoff_s,
+                max_backoff_s=settings.supervisor_max_backoff_s)
+        else:
+            self._supervisor = SupervisorPolicy()
+        return self._supervisor
+
+    def _metrics(self):
+        """The instance metrics registry, if this component hangs off a
+        runtime that has one (duck-typed)."""
+        m = getattr(self._root(), "metrics", None)
+        return m if m is not None and hasattr(m, "counter") else None
 
     def _on_task_done(self, task: asyncio.Task) -> None:
         # a crashed loop must be visible in health, not silently dead
         if task.cancelled():
             return
         exc = task.exception()
-        if exc is not None:
+        if exc is None:
+            return
+        self.last_crash = exc
+        if self.status is not LifecycleStatus.STARTED:
+            # crashed while stopping/stopped: _do_stop already surfaced
+            # it — recording LIFECYCLE_ERROR here would flip a cleanly
+            # stopped component back to error after the fact
+            logger.warning("%s: task ended with %s: %s while %s",
+                           self.path, type(exc).__name__, exc,
+                           self.status.value)
+            return
+        policy = self._policy()
+        now = time.monotonic()
+        self._crash_times = [t for t in self._crash_times
+                             if now - t < policy.window_s]
+        self._crash_times.append(now)
+        if len(self._crash_times) > policy.max_restarts:
+            # over budget: permanent, loud failure — no more respawns
             self._record_error(exc, LifecycleStatus.LIFECYCLE_ERROR)
+            return
+        self.restart_count += 1
+        metrics = self._metrics()
+        if metrics is not None:
+            metrics.counter("supervisor.restarts").inc()
+            metrics.counter(f"supervisor.restarts:{self.path}").inc()
+        delay = policy.backoff(len(self._crash_times))
+        logger.warning(
+            "%s crashed (%s: %s); restart %d/%d in %.2fs",
+            self.path, type(exc).__name__, exc, len(self._crash_times),
+            policy.max_restarts, delay,
+            exc_info=(type(exc), exc, exc.__traceback__))
+        self._restart_task = asyncio.get_running_loop().create_task(
+            self._restart_after(delay), name=f"{self.path}/supervisor")
+
+    async def _restart_after(self, delay: float) -> None:
+        await asyncio.sleep(delay)
+        if self.status is LifecycleStatus.STARTED:
+            self._spawn()
 
     async def _do_stop(self, monitor: LifecycleProgressMonitor) -> None:
+        if self._restart_task is not None:
+            self._restart_task.cancel()
+            try:
+                await self._restart_task
+            except asyncio.CancelledError:
+                pass
+            self._restart_task = None
         if self._task is not None:
             self._task.cancel()
             try:
@@ -270,3 +397,20 @@ class BackgroundTaskComponent(LifecycleComponent):
             except BaseException:  # noqa: BLE001 - task error surfaces here
                 logger.exception("%s: background task failed during stop", self.path)
             self._task = None
+
+    def state_tree(self) -> dict:
+        out = super().state_tree()
+        out["restarts"] = self.restart_count
+        if self.last_crash is not None and self.error is None:
+            # a supervised crash that was recovered: visible, not fatal
+            out["last_crash"] = repr(self.last_crash)
+        return out
+
+
+class SupervisedTaskComponent(BackgroundTaskComponent):
+    """BackgroundTaskComponent with an explicit, per-component
+    `SupervisorPolicy` (components that need a tuned restart budget
+    rather than the instance defaults)."""
+
+    def __init__(self, name: str, policy: SupervisorPolicy):
+        super().__init__(name, supervisor=policy)

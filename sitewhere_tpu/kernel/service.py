@@ -61,6 +61,21 @@ class TenantEngine(LifecycleComponent):
     def tenant_topic(self, function: str) -> str:
         return self.runtime.naming.tenant_topic(self.tenant_id, function)
 
+    @property
+    def dead_letter_topic(self) -> str:
+        return self.tenant_topic(TopicNaming.DEAD_LETTER)
+
+    async def dead_letter(self, record, exc: BaseException,
+                          stage: str) -> None:
+        """Quarantine a poison record to this tenant's dead-letter
+        topic with provenance (kernel/dlq.py) — the per-record catch
+        every consuming loop routes through. Never raises."""
+        from sitewhere_tpu.kernel.dlq import quarantine
+
+        await quarantine(self.runtime.bus, self.dead_letter_topic, record,
+                         exc, stage, metrics=self.runtime.metrics,
+                         tenant_id=self.tenant_id)
+
 
 class Service(LifecycleComponent):
     """One logical microservice (reference: ConfigurableMicroservice).
@@ -117,6 +132,15 @@ class Service(LifecycleComponent):
         engine = self.engines.pop(tenant_id, None)
         if engine is not None:
             await engine.stop()
+
+    def state_tree(self) -> dict:
+        """Include tenant engines: they are dict-managed (spun by the
+        engine manager), not lifecycle children, but a crashed or
+        budget-exhausted loop inside one MUST show in health."""
+        out = super().state_tree()
+        out["children"].extend(
+            e.state_tree() for _, e in sorted(self.engines.items()))
+        return out
 
     # -- convenience -------------------------------------------------------
 
@@ -208,6 +232,10 @@ class ServiceRuntime(LifecycleComponent):
         self.services: dict[str, Service] = {}
         self.remotes: dict[str, Any] = {}   # identifier -> RemoteService
         self.tenants: dict[str, TenantConfig] = {}
+        # chaos seam: a FaultInjector (kernel/faults.py) installed via
+        # install_faults(); None in production — every consulted site
+        # guards with one `is not None` test
+        self.faults = None
         # monotonic change counter over the tenant-config map — the
         # instance snapshotter's debounce epoch (a size-based epoch
         # aliases: delete bumps a counter while the size drops)
@@ -232,6 +260,16 @@ class ServiceRuntime(LifecycleComponent):
                                                       secret=secret))
         self.remotes[identifier] = remote
         return remote
+
+    def install_faults(self, injector: Any) -> Any:
+        """Install a FaultInjector on the runtime and its bus (chaos
+        tests / `bench.py --chaos`). Install BEFORE tenants are added:
+        engines capture the injector when they build their durable logs
+        and scoring sessions. Returns the injector (chainable)."""
+        self.faults = injector
+        if hasattr(self.bus, "faults"):
+            self.bus.faults = injector
+        return injector
 
     def api(self, identifier: str) -> Any:
         """In-proc equivalent of a gRPC ApiChannel to `identifier`."""

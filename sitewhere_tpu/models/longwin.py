@@ -30,7 +30,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sitewhere_tpu.models.common import dense_init
-from sitewhere_tpu.parallel.ring import dense_attention_reference, ring_attention
+from sitewhere_tpu.parallel.ring import (
+    dense_attention_reference,
+    ring_attention,
+    shard_map,
+)
 
 
 @dataclass(frozen=True)
@@ -157,7 +161,7 @@ class LongWindowModel:
         def body(xn, valid):
             return self._stack(params, xn, valid, ax)
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=self.mesh, in_specs=(spec_x, spec_x),
             out_specs=P(None, ax, None))(xn, valid)
 

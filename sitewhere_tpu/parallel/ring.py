@@ -31,6 +31,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # stabilized as jax.shard_map in newer JAX releases
+    shard_map = jax.shard_map
+except AttributeError:  # this image's 0.4.x still ships it experimental
+    from jax.experimental.shard_map import shard_map
+
+try:  # newer JAX types device-varying values explicitly
+    _pvary = jax.lax.pvary
+except AttributeError:  # 0.4.x has no varying-type system: identity
+    def _pvary(x, axes):
+        return x
+
 NEG_INF = -1e30
 
 
@@ -76,9 +87,9 @@ def ring_attention(q, k, v, valid, axis_name: str, causal: bool = False,
     # online-softmax state: accumulator o, running max m, running denom l
     # (pvary: the carries become device-varying after the first fold, so
     # their init must be typed device-varying for shard_map's scan)
-    o = jax.lax.pvary(jnp.zeros((B, T_l, H, Dh), jnp.float32), (axis_name,))
-    m = jax.lax.pvary(jnp.full((B, H, T_l), NEG_INF, jnp.float32), (axis_name,))
-    l = jax.lax.pvary(jnp.zeros((B, H, T_l), jnp.float32), (axis_name,))
+    o = _pvary(jnp.zeros((B, T_l, H, Dh), jnp.float32), (axis_name,))
+    m = _pvary(jnp.full((B, H, T_l), NEG_INF, jnp.float32), (axis_name,))
+    l = _pvary(jnp.zeros((B, H, T_l), jnp.float32), (axis_name,))
 
     perm = [(i, (i + 1) % P_sz) for i in range(P_sz)]
 
@@ -127,7 +138,7 @@ def ring_attention_sharded(q, k, v, valid, mesh: Mesh, seq_axis: str,
         return ring_attention(q, k, v, valid, seq_axis, causal=causal,
                               axis_size=axis_size)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_valid),
         out_specs=spec_qkv)

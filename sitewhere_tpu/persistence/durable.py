@@ -188,13 +188,17 @@ class DurableEventLog:
 
     def __init__(self, directory: str, segment_bytes: int = 4 << 20,
                  max_segments: int = 64, fsync_interval_s: float = 0.2,
-                 queue_max: int = 4096):
+                 queue_max: int = 4096, faults=None):
         self.log = SegmentLog(directory, segment_bytes=segment_bytes,
                               max_segments=max_segments,
                               fsync_interval_s=fsync_interval_s)
         self._q: queue.Queue = queue.Queue(maxsize=queue_max)
         self.dropped = 0
         self.written = 0
+        self.write_errors = 0
+        # chaos seam (kernel/faults.py "durable.flush"): consulted from
+        # the writer thread; None in production
+        self._faults = faults
         self._thread = threading.Thread(
             target=self._run, name=f"swx-spill:{os.path.basename(directory)}",
             daemon=True)
@@ -234,6 +238,8 @@ class DurableEventLog:
                     logger.warning("spill fsync failed", exc_info=True)
                 continue
             try:
+                if self._faults is not None:
+                    self._faults.check("durable.flush")
                 self.log.append(rtype, self._encode(rtype, obj))
                 self.written += 1
                 # unconditional: _sync rate-limits its own fsync, but
@@ -246,7 +252,9 @@ class DurableEventLog:
                 # ingest, and a writer thread that dies on a disk fault
                 # would silently end ALL durability while the process
                 # keeps reporting itself durable
-                logger.warning("spill write failed; record lost",
+                self.write_errors += 1
+                logger.warning("spill write failed; record lost "
+                               "(%d so far)", self.write_errors,
                                exc_info=True)
         try:
             self.log.close()

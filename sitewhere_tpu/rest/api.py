@@ -405,6 +405,9 @@ class RestServer(LifecycleComponent):
         r("POST", r"/api/assets", self.create_asset)
         # alerts (tenant-wide)
         r("GET", r"/api/alerts", self.list_tenant_alerts)
+        # dead-letter quarantine (poison records; kernel/dlq.py)
+        r("GET", r"/api/dlq", self.list_dlq)
+        r("POST", r"/api/dlq/replay", self.replay_dlq)
         # batch + training
         r("POST", r"/api/batch/command", self.batch_command)
         r("POST", r"/api/batch/train", self.batch_train)
@@ -870,6 +873,62 @@ class RestServer(LifecycleComponent):
     async def list_tenant_alerts(self, req: Request):
         return [event_to_dict(a) for a in self._em(req).list_alerts(
             limit=req.int_qp("limit", 100))]
+
+    # -- handlers: dead-letter quarantine ----------------------------------
+
+    def _dlq_topic(self, req: Request) -> str:
+        from sitewhere_tpu.kernel.bus import TopicNaming
+
+        if not hasattr(self.runtime.bus, "peek"):
+            raise HttpError(501, "dead-letter surface needs the in-proc "
+                                 "bus (this process attaches to a wire "
+                                 "broker)")
+        return self.runtime.naming.tenant_topic(
+            self._tenant_id(req), TopicNaming.DEAD_LETTER)
+
+    async def list_dlq(self, req: Request):
+        """Newest dead letters for the tenant: provenance (original
+        topic/partition/offset, failing component, error summary) plus
+        a jsonable view of the quarantined value."""
+        from sitewhere_tpu.kernel.dlq import list_dead_letters
+        from sitewhere_tpu.services.outbound_connectors import (
+            record_to_jsonable,
+        )
+
+        out = []
+        for rec, entry in list_dead_letters(
+                self.runtime.bus, self._dlq_topic(req),
+                limit=req.int_qp("limit", 100)):
+            try:
+                value = record_to_jsonable(entry["value"])
+            except Exception:  # noqa: BLE001 - poison may not serialize
+                value = {"kind": "unserializable",
+                         "repr": repr(entry["value"])[:500]}
+            out.append({
+                "dlq_partition": rec.partition,
+                "dlq_offset": rec.offset,
+                "original_topic": entry["original_topic"],
+                "partition": entry["partition"],
+                "offset": entry["offset"],
+                "key": entry.get("key"),
+                "stage": entry["stage"],
+                "error": entry["error"],
+                "quarantined_at": entry["quarantined_at"],
+                "value": value,
+            })
+        return out
+
+    async def replay_dlq(self, req: Request):
+        """Re-produce dead letters onto their original topics (body:
+        {"limit": N}, default all outstanding). Progress commits under
+        a replay group, so repeated calls never duplicate."""
+        from sitewhere_tpu.kernel.dlq import replay_dead_letters
+
+        limit = req.json().get("limit")
+        n = await replay_dead_letters(
+            self.runtime.bus, self._dlq_topic(req), limit=limit,
+            metrics=self.runtime.metrics)
+        return {"replayed": n}
 
     # -- handlers: areas/customers/zones/assets ----------------------------
 

@@ -94,12 +94,17 @@ class ScoringSession:
     def __init__(self, model, telemetry: TelemetryStore,
                  metrics: MetricsRegistry, cfg: ScoringConfig = ScoringConfig(),
                  params: Optional[dict] = None, sink: Optional[Sink] = None,
-                 tracer=None):
+                 tracer=None, faults=None):
         self.model = model
         self.telemetry = telemetry
         self.cfg = cfg
         self.sink = sink
         self.tracer = tracer
+        # chaos seam (kernel/faults.py "scoring.dispatch"): consulted
+        # before a flush takes its pending admissions, so an injected
+        # crash loses nothing — the supervisor restarts the consuming
+        # loop and the still-pending events flush on the next tick
+        self.faults = faults
         self.params = jax.device_put(
             params if params is not None
             else model.init(jax.random.PRNGKey(cfg.seed)))
@@ -613,6 +618,8 @@ class ScoringSession:
         `self.sink` when they settle. Returns False if nothing flushed."""
         if self._pending_n == 0 or self.inflight >= self.cfg.max_inflight:
             return False
+        if self.faults is not None:
+            self.faults.check("scoring.dispatch")
         if self._pending_max >= self.ring.capacity:
             self._start_regrow()  # grow+compile off the hot path
             return False
@@ -628,6 +635,8 @@ class ScoringSession:
         (no silent partial results)."""
         if self._pending_n == 0:
             return None
+        if self.faults is not None:
+            self.faults.check("scoring.dispatch")
         dev, val, ts, ingest, ctx, traces = self._take_pending()
         futs: list[asyncio.Future] = []
         _, failed = self._dispatch_chunks(dev, val, ts, ingest, ctx,

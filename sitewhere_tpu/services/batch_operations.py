@@ -184,6 +184,14 @@ class BatchElementProcessor(BackgroundTaskComponent):
                 for record in await consumer.poll(max_records=16, timeout=0.5):
                     chunk = record.value
                     try:
+                        if not isinstance(chunk, dict) \
+                                or "operation_id" not in chunk:
+                            # a non-chunk on the elements topic used to
+                            # poison the loop TWICE: the AttributeError
+                            # here and then chunk["operation_id"] in the
+                            # old error path — straight to the DLQ
+                            raise TypeError(
+                                f"not a batch-element chunk: {type(chunk)}")
                         if chunk.get("train"):
                             await self._run_training(chunk["operation_id"])
                         elif chunk.get("maintenance"):
@@ -191,12 +199,18 @@ class BatchElementProcessor(BackgroundTaskComponent):
                         else:
                             n = await self._process_command_chunk(chunk)
                             processed.inc(n)
-                    except Exception:  # noqa: BLE001
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
                         logger.exception("batch chunk failed")
-                        engine._set_status(
-                            chunk["operation_id"],
-                            BatchOperationStatus.FINISHED_WITH_ERRORS,
-                            ended=True)
+                        await engine.dead_letter(record, exc, self.path)
+                        if isinstance(chunk, dict) and \
+                                engine.spi.get_batch_operation(
+                                    chunk.get("operation_id", "")) is not None:
+                            engine._set_status(
+                                chunk["operation_id"],
+                                BatchOperationStatus.FINISHED_WITH_ERRORS,
+                                ended=True)
                 consumer.commit()
         finally:
             consumer.close()

@@ -380,15 +380,26 @@ class CommandDeliveryManager(BackgroundTaskComponent):
                     value = record.value
                     if not isinstance(value, list):
                         continue
-                    for ev in value:
-                        if isinstance(ev, DeviceCommandInvocation):
-                            ok = await self._deliver(dm, ev)
-                            if ok:
-                                delivered.inc()
-                            else:
-                                failed.inc()
-                                await runtime.bus.produce(
-                                    undelivered_topic, ev, key=ev.device_id)
+                    # poison quarantine: per-delivery failures already
+                    # route to the undelivered topic; anything escaping
+                    # that (a malformed invocation list, a broken
+                    # undelivered produce) quarantines the record so
+                    # command routing keeps draining
+                    try:
+                        for ev in value:
+                            if isinstance(ev, DeviceCommandInvocation):
+                                ok = await self._deliver(dm, ev)
+                                if ok:
+                                    delivered.inc()
+                                else:
+                                    failed.inc()
+                                    await runtime.bus.produce(
+                                        undelivered_topic, ev,
+                                        key=ev.device_id)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
                 consumer.commit()
         finally:
             consumer.close()

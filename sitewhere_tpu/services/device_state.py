@@ -146,13 +146,20 @@ class StateMerger(BackgroundTaskComponent):
             while True:
                 for record in await consumer.poll(max_records=256, timeout=0.2):
                     batch = record.value
-                    if isinstance(batch, MeasurementBatch):
-                        engine.merge_measurements(batch)
-                        merged.mark(len(batch))
-                    elif isinstance(batch, LocationBatch):
-                        engine.merge_locations(batch)
-                        merged.mark(len(batch))
-                    # cold event lists don't update dense state
+                    # poison quarantine: a batch the merge rejects goes
+                    # to the tenant DLQ; state merging keeps flowing
+                    try:
+                        if isinstance(batch, MeasurementBatch):
+                            engine.merge_measurements(batch)
+                            merged.mark(len(batch))
+                        elif isinstance(batch, LocationBatch):
+                            engine.merge_locations(batch)
+                            merged.mark(len(batch))
+                        # cold event lists don't update dense state
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
                 consumer.commit()
         finally:
             consumer.close()

@@ -11,6 +11,7 @@ hop; that cost is deleted by design).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Sequence
@@ -29,6 +30,11 @@ from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.persistence.memory import InMemoryDeviceEventManagement
 
 logger = logging.getLogger(__name__)
+
+
+class _Skip(Exception):
+    """Unknown record kind: logged and skipped, not dead-lettered (a
+    foreign value on the inbound topic is noise, not poison)."""
 
 
 class EventManagementEngine(TenantEngine):
@@ -60,7 +66,8 @@ class EventManagementEngine(TenantEngine):
                 max_segments=cfg.get("durable_max_segments",
                                      settings.durable_max_segments),
                 fsync_interval_s=cfg.get("durable_fsync_interval_s",
-                                         settings.durable_fsync_interval_s))
+                                         settings.durable_fsync_interval_s),
+                faults=self.runtime.faults)
         self.spi = InMemoryDeviceEventManagement(
             dm, history=cfg.get("history", 1024),
             cold_retention=cfg.get("cold_retention", 100_000),
@@ -131,43 +138,71 @@ class EventPersister(BackgroundTaskComponent):
         try:
             while True:
                 for record in await consumer.poll(max_records=256, timeout=0.2):
-                    batch = record.value
-                    t_span = time.monotonic()
-                    if isinstance(batch, MeasurementBatch):
-                        persisted.mark(spi.add_measurements(batch))
-                    elif isinstance(batch, LocationBatch):
-                        persisted.mark(spi.add_locations(batch))
-                    elif isinstance(batch, AlertBatch):
-                        persisted.mark(len(spi.add_alert_batch(batch)))
-                    elif isinstance(batch, list):  # cold per-event objects
-                        stored = 0
-                        for ev in batch:
-                            if isinstance(ev, DeviceAlert):
-                                spi.add_alerts([ev])
-                            elif isinstance(ev, DeviceCommandResponse):
-                                spi.add_command_responses([ev])
-                            elif isinstance(ev, DeviceStateChange):
-                                spi.add_state_changes([ev])
-                            else:
-                                logger.warning("event-mgmt: unpersistable cold"
-                                               " event %r", type(ev))
-                                continue
-                            stored += 1
-                        persisted.mark(stored)
-                    else:
-                        logger.warning("event-mgmt: unknown record %r", type(batch))
+                    # poison quarantine: a batch the store rejects goes
+                    # to the tenant DLQ; the persister keeps draining
+                    try:
+                        self._persist(record, spi, runtime, tenant_id,
+                                      persisted)
+                    except asyncio.CancelledError:
+                        raise
+                    except _Skip:
                         continue
-                    await runtime.bus.produce(enriched_topic, batch,
-                                              key=record.key)
-                    ctx = getattr(batch, "ctx", None)
-                    if ctx is not None:
-                        runtime.tracer.record(
-                            ctx.trace_id, "event-management.persist",
-                            tenant_id, t_span, time.monotonic() - t_span,
-                            len(batch))
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
+                        continue
+                    # the batch is already persisted: a failed enriched
+                    # re-publish must NOT dead-letter it (replay would
+                    # run it through the persister again and store the
+                    # events twice) — count the lost enrichment instead
+                    try:
+                        await runtime.bus.produce(enriched_topic,
+                                                  record.value,
+                                                  key=record.key)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 - counted, not poison
+                        runtime.metrics.counter(
+                            "event_management.enrich_publish_failures").inc()
+                        logger.exception(
+                            "event-mgmt[%s]: enriched re-publish failed; "
+                            "batch persisted but not enriched", tenant_id)
                 consumer.commit()
         finally:
             consumer.close()
+
+    def _persist(self, record, spi, runtime, tenant_id, persisted) -> None:
+        batch = record.value
+        t_span = time.monotonic()
+        if isinstance(batch, MeasurementBatch):
+            persisted.mark(spi.add_measurements(batch))
+        elif isinstance(batch, LocationBatch):
+            persisted.mark(spi.add_locations(batch))
+        elif isinstance(batch, AlertBatch):
+            persisted.mark(len(spi.add_alert_batch(batch)))
+        elif isinstance(batch, list):  # cold per-event objects
+            stored = 0
+            for ev in batch:
+                if isinstance(ev, DeviceAlert):
+                    spi.add_alerts([ev])
+                elif isinstance(ev, DeviceCommandResponse):
+                    spi.add_command_responses([ev])
+                elif isinstance(ev, DeviceStateChange):
+                    spi.add_state_changes([ev])
+                else:
+                    logger.warning("event-mgmt: unpersistable cold"
+                                   " event %r", type(ev))
+                    continue
+                stored += 1
+            persisted.mark(stored)
+        else:
+            logger.warning("event-mgmt: unknown record %r", type(batch))
+            raise _Skip()
+        ctx = getattr(batch, "ctx", None)
+        if ctx is not None:
+            runtime.tracer.record(
+                ctx.trace_id, "event-management.persist",
+                tenant_id, t_span, time.monotonic() - t_span,
+                len(batch))
 
 
 class EventManagementService(Service):

@@ -13,6 +13,7 @@ the same at-least-once semantics.
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import logging
 import time
@@ -64,34 +65,51 @@ class InboundProcessor(BackgroundTaskComponent):
                 if dm_service is not None:
                     dm = dm_service.engines.get(tenant_id, dm)
                 for record in await consumer.poll(max_records=256, timeout=0.2):
-                    batch = record.value
-                    t_span = time.monotonic()
-                    if isinstance(batch, (MeasurementBatch, LocationBatch)):
-                        mask = dm.registered_mask(batch.device_index)
-                        if inspect.isawaitable(mask):
-                            mask = await mask  # device-mgmt in a peer process
-                        n_bad = int((~mask).sum())
-                        if n_bad:
-                            dropped.inc(n_bad)
-                            bad = batch.device_index[~mask]
-                            await runtime.bus.produce(
-                                unregistered_topic,
-                                {"device_indices": bad, "ctx": batch.ctx})
-                            batch = batch.select(mask)
-                        if len(batch):
-                            processed.mark(len(batch))
-                            await runtime.bus.produce(inbound_topic, batch,
-                                                      key=record.key)
-                        runtime.tracer.record(
-                            batch.ctx.trace_id, "inbound.enrich", tenant_id,
-                            t_span, time.monotonic() - t_span, len(batch))
-                    elif isinstance(batch, RegistrationBatch):
-                        await runtime.bus.produce(unregistered_topic, batch)
-                    else:
-                        logger.warning("inbound: unknown record %r", type(batch))
+                    # poison quarantine: a record whose handling raises
+                    # goes to the tenant DLQ (with provenance) and the
+                    # loop keeps draining — one bad record must never
+                    # kill the tenant's whole inbound path
+                    try:
+                        if runtime.faults is not None:
+                            runtime.faults.check("inbound.handle")
+                        await self._handle(record, dm, runtime, tenant_id,
+                                           inbound_topic, unregistered_topic,
+                                           processed, dropped)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
                 consumer.commit()
         finally:
             consumer.close()
+
+    async def _handle(self, record, dm, runtime, tenant_id, inbound_topic,
+                      unregistered_topic, processed, dropped) -> None:
+        batch = record.value
+        t_span = time.monotonic()
+        if isinstance(batch, (MeasurementBatch, LocationBatch)):
+            mask = dm.registered_mask(batch.device_index)
+            if inspect.isawaitable(mask):
+                mask = await mask  # device-mgmt in a peer process
+            n_bad = int((~mask).sum())
+            if n_bad:
+                dropped.inc(n_bad)
+                bad = batch.device_index[~mask]
+                await runtime.bus.produce(
+                    unregistered_topic,
+                    {"device_indices": bad, "ctx": batch.ctx})
+                batch = batch.select(mask)
+            if len(batch):
+                processed.mark(len(batch))
+                await runtime.bus.produce(inbound_topic, batch,
+                                          key=record.key)
+            runtime.tracer.record(
+                batch.ctx.trace_id, "inbound.enrich", tenant_id,
+                t_span, time.monotonic() - t_span, len(batch))
+        elif isinstance(batch, RegistrationBatch):
+            await runtime.bus.produce(unregistered_topic, batch)
+        else:
+            logger.warning("inbound: unknown record %r", type(batch))
 
 
 class InboundProcessingService(Service):

@@ -429,14 +429,24 @@ class OutboundManager(BackgroundTaskComponent):
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
                     # snapshot: REST add/delete mutates the dict while
-                    # process() is suspended; a live iterator would die
-                    for connector in list(engine.connectors.values()):
-                        try:
-                            await connector.process(record.value)
-                        except Exception:  # noqa: BLE001 - connector isolated
-                            logger.exception("connector %s failed",
-                                             connector.name)
-                    forwarded.mark(1)
+                    # process() is suspended; a live iterator would die.
+                    # Connector failures stay isolated per connector (a
+                    # record other connectors handled fine is not
+                    # poison); anything escaping that isolation (e.g. a
+                    # record the snapshot loop itself chokes on) is
+                    # quarantined so the fan-out keeps draining.
+                    try:
+                        for connector in list(engine.connectors.values()):
+                            try:
+                                await connector.process(record.value)
+                            except Exception:  # noqa: BLE001 - isolated
+                                logger.exception("connector %s failed",
+                                                 connector.name)
+                        forwarded.mark(1)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
                 consumer.commit()
         finally:
             consumer.close()

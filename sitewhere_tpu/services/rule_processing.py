@@ -152,7 +152,8 @@ class RuleProcessingEngine(TenantEngine):
             model = build_model(self.model_name, **self.model_config)
             self.session = ScoringSession(
                 model, em.telemetry, self.runtime.metrics, self.scoring_cfg,
-                sink=self._deliver_scored, tracer=self.runtime.tracer)
+                sink=self._deliver_scored, tracer=self.runtime.tracer,
+                faults=self.runtime.faults)
 
     async def _do_start(self, monitor) -> None:
         if self.session is not None:
@@ -336,8 +337,18 @@ class RuleProcessor(BackgroundTaskComponent):
                     lost_seen = lost
                 for record in records:
                     value = record.value
-                    if sink is not None and isinstance(value, MeasurementBatch):
-                        sink.admit(value)
+                    # poison quarantine: an admit the scorer rejects
+                    # (malformed batch) dead-letters the record; the
+                    # tenant's scoring path keeps flowing
+                    try:
+                        if sink is not None and isinstance(value,
+                                                           MeasurementBatch):
+                            sink.admit(value)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
+                        continue
                     # snapshot: uploads may mutate hooks mid-await
                     for name, hook in list(engine.hooks.items()):
                         try:

@@ -107,10 +107,10 @@ class KafkaWireClient:
         msgs = decode_message_set(mv[off:off + max(mset_len, 0)])
         return err, hwm, msgs
 
-    async def list_offsets(self, topic, partition, ts):
+    async def list_offsets(self, topic, partition, ts, max_n=1):
         body = (struct.pack(">i", -1) + struct.pack(">i", 1) + _s(topic)
                 + struct.pack(">i", 1)
-                + struct.pack(">iqi", partition, ts, 1))
+                + struct.pack(">iqi", partition, ts, max_n))
         mv = await self._call(2, body)
         off = 4
         nlen = struct.unpack_from(">h", mv, off)[0]
@@ -459,18 +459,67 @@ def test_protocol_edges(run):
             assert msgs[0][1] == b"raw-json"
 
             # timestamp ListOffsets: first record at/after the point
-            # (bus stamps wall-clock seconds at produce)
+            # (bus stamps wall-clock seconds at produce). Sleep on BOTH
+            # sides of t_mid and round UP: int() truncation of a point
+            # taken sub-ms after the first produce could land the query
+            # at-or-before that record's timestamp (flaked 1-in-3 runs)
+            import math
             import time as _time
 
-            t_mid = (_time.time() + 0.0005) * 1000
+            await asyncio.sleep(0.01)
+            t_mid = math.ceil(_time.time() * 1000)
             await asyncio.sleep(0.01)
             await bus.produce("ff", "later", partition=0)
-            err, offs = await client.list_offsets("ff", 0, int(t_mid))
+            err, offs = await client.list_offsets("ff", 0, t_mid)
             assert err == 0 and offs == [1]
+
+            # max_num_offsets=0 -> empty offsets array, like a real
+            # broker (the old [:max(n,1)] floor always returned one)
+            err, offs = await client.list_offsets("ff", 0, -1, max_n=0)
+            assert err == 0 and offs == []
 
             # offset-0 commit sticks (prev default must be -1, not 0)
             await client.offset_commit("gz", "ff", 0, 0)
             assert await client.offset_fetch("gz", "ff", 0) == 0
+        finally:
+            await client.close()
+            await ep.stop()
+            await bus.stop()
+
+    run(main())
+
+
+def test_auto_create_topic_cap(run):
+    """Unauthenticated peers can grow the topic map only up to the
+    endpoint's auto-create cap; past it they get
+    UNKNOWN_TOPIC_OR_PARTITION — while topics the in-proc services
+    created are always served."""
+    async def main():
+        bus = EventBus(default_partitions=2)
+        await bus.initialize()
+        await bus.start()
+        ep = KafkaEndpoint(bus, auto_create_limit=2)
+        await ep.start()
+        client = KafkaWireClient("127.0.0.1", ep.port)
+        await client.connect()
+        try:
+            err, _ = await client.produce("cap-a", 0, [(None, b"x")])
+            assert err == 0
+            err, offs = await client.list_offsets("cap-b", 0, -1)
+            assert err == 0
+            # cap reached: produce/fetch/list_offsets all deny
+            err, _ = await client.produce("cap-c", 0, [(None, b"x")])
+            assert err == 3
+            err, _hwm, _msgs = await client.fetch("cap-c", 0, 0)
+            assert err == 3
+            err, offs = await client.list_offsets("cap-c", 0, -1)
+            assert err == 3 and offs == []
+            # the denied topic never entered the bus map
+            assert "cap-c" not in bus.topic_names()
+            # service-created topics don't count against (or hit) the cap
+            await bus.produce("svc-topic", b"y", partition=0)
+            err, _hwm, msgs = await client.fetch("svc-topic", 0, 0)
+            assert err == 0 and msgs[0][1] == b"y"
         finally:
             await client.close()
             await ep.stop()
