@@ -387,6 +387,7 @@ async def main():
                 "p99_ms": round(session.latency.quantile(0.99) * 1e3, 3),
                 "p99_breakdown": {
                     nm: {"p50_ms": round(h.quantile(0.5) * 1e3, 3),
+                         "p95_ms": round(h.quantile(0.95) * 1e3, 3),
                          "p99_ms": round(h.quantile(0.99) * 1e3, 3)}
                     for nm, h in stages.items()},
                 "inflight": session.inflight,
@@ -691,6 +692,215 @@ def run_train_bench(args) -> dict:
     }
 
 
+async def run_overload_bench(args) -> dict:
+    """--overload: per-tenant flow-control isolation proof.
+
+    One hog tenant offers 10× its quota while N well-behaved tenants
+    offer half of theirs. Two measured phases in ONE run:
+
+      baseline   well-behaved tenants alone (their no-hog goodput)
+      contended  the same offered load + the hog at 10× quota
+
+    The artifact records per-tenant goodput (scored events/s off the
+    scored-events topic), shed counts (`flow.rejected:*`), and each
+    phase's e2e p50/p95/p99. Acceptance (ISSUE 2): the hog is capped
+    near its quota and every well-behaved tenant keeps ≥90% of its
+    baseline goodput."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sitewhere_tpu.config import InstanceSettings, TenantConfig
+    from sitewhere_tpu.domain.model import DeviceType
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.services import (
+        DeviceManagementService,
+        DeviceStateService,
+        EventManagementService,
+        EventSourcesService,
+        InboundProcessingService,
+        RuleProcessingService,
+    )
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    platform, device_kind, n_chips = probe_backend()
+    devices = args.overload_devices
+    quota = args.quota
+    window = 32
+    good_ids = [f"good{i}" for i in range(args.overload_tenants)]
+    all_ids = good_ids + ["hog"]
+
+    rt = ServiceRuntime(InstanceSettings(
+        instance_id="overload-bench",
+        engine_ready_timeout_s=args.ready_timeout))
+    for cls in (DeviceManagementService, EventSourcesService,
+                InboundProcessingService, EventManagementService,
+                DeviceStateService, RuleProcessingService):
+        rt.add_service(cls(rt))
+    await rt.start()
+    for tid in all_ids:
+        await rt.add_tenant(TenantConfig(tenant_id=tid, sections={
+            "flow": {"rate": quota, "burst": quota},
+            "event-management": {"history": window * 2},
+            "rule-processing": {
+                "model": "zscore",
+                "model_config": {"window": window},
+                "threshold": 6.0, "batch_window_ms": args.window_ms,
+                "buckets": [devices], "capacity": devices,
+                "max_inflight": args.max_inflight,
+            },
+        }))
+
+    sims, receivers, sessions = {}, {}, {}
+    for tid in all_ids:
+        dm = rt.api("device-management").management(tid)
+        dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), devices)
+        em = rt.api("event-management").management(tid)
+        sim = DeviceSimulator(SimConfig(num_devices=devices),
+                              tenant_id=tid)
+        for k in range(window + 4):
+            em.telemetry.append_measurements(sim.tick(t=60.0 * k)[0])
+        sims[tid] = sim
+        receivers[tid] = rt.api("event-sources").engine(tid) \
+            .receiver("default")
+        sessions[tid] = rt.api("rule-processing").engine(tid).session
+    t_warm = time.monotonic()
+    while not all(s.ready for s in sessions.values()):
+        await asyncio.sleep(0.1)
+        if time.monotonic() - t_warm > args.ready_timeout:
+            raise TimeoutError("scoring warmup timed out")
+    for s in sessions.values():
+        s.reload_history()
+
+    # per-tenant goodput meters: consume each tenant's scored topic
+    scored_counts = {tid: 0 for tid in all_ids}
+    consumers = {tid: rt.bus.subscribe(
+        rt.naming.tenant_topic(tid, "scored-events"),
+        group="overload-bench-meter") for tid in all_ids}
+
+    def drain_scored():
+        for tid, c in consumers.items():
+            for r in c.poll_nowait(max_records=512):
+                scored_counts[tid] += len(r.value)
+
+    lat_hist = sessions["hog"].latency  # shared registry histogram
+
+    async def drive(tids_rates: dict, seconds: float) -> dict:
+        """Paced open-loop offered load per tenant; returns per-tenant
+        {offered, accepted} (a False submit = shed at ingress)."""
+        t0 = time.monotonic()
+        stats = {tid: {"offered": 0, "accepted": 0}
+                 for tid in tids_rates}
+        next_t = {tid: t0 for tid in tids_rates}
+        interval = {tid: devices / rate for tid, rate in tids_rates.items()}
+        k = 0
+        while time.monotonic() - t0 < seconds:
+            now = time.monotonic()
+            soonest = now + 1.0
+            for tid in tids_rates:
+                if next_t[tid] <= now:
+                    payload, _ = sims[tid].payload(
+                        t=60.0 * (window + 10) + 0.001 * k)
+                    k += 1
+                    ok = await receivers[tid].submit(payload)
+                    stats[tid]["offered"] += devices
+                    if ok:
+                        stats[tid]["accepted"] += devices
+                    next_t[tid] += interval[tid]
+                soonest = min(soonest, next_t[tid])
+            drain_scored()
+            delay = soonest - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(min(delay, 0.05))
+            else:
+                await asyncio.sleep(0)
+        return stats
+
+    async def settle(bound: float) -> None:
+        deadline = time.monotonic() + bound
+        last = sum(scored_counts.values())
+        quiet_since = time.monotonic()
+        while time.monotonic() < deadline:
+            drain_scored()
+            total = sum(scored_counts.values())
+            if total != last:
+                last, quiet_since = total, time.monotonic()
+            elif time.monotonic() - quiet_since > 1.0:
+                break
+            await asyncio.sleep(0.05)
+
+    def phase_latency() -> dict:
+        return {"p50_ms": round(lat_hist.quantile(0.5) * 1e3, 3),
+                "p95_ms": round(lat_hist.quantile(0.95) * 1e3, 3),
+                "p99_ms": round(lat_hist.quantile(0.99) * 1e3, 3)}
+
+    good_rate = 0.5 * quota
+    seconds = args.seconds
+
+    # phase A: baseline — well-behaved tenants alone
+    drain_scored()
+    for tid in all_ids:
+        scored_counts[tid] = 0
+    lat_hist.reset()
+    t0 = time.monotonic()
+    base_stats = await drive({tid: good_rate for tid in good_ids}, seconds)
+    await settle(args.drain_timeout)
+    base_elapsed = time.monotonic() - t0
+    baseline = {tid: scored_counts[tid] / base_elapsed for tid in good_ids}
+    base_lat = phase_latency()
+
+    # phase B: contended — same offered load + the hog at 10× quota
+    for tid in all_ids:
+        scored_counts[tid] = 0
+    lat_hist.reset()
+    rates = {tid: good_rate for tid in good_ids}
+    rates["hog"] = args.hog_multiple * quota
+    t0 = time.monotonic()
+    cont_stats = await drive(rates, seconds)
+    await settle(args.drain_timeout)
+    cont_elapsed = time.monotonic() - t0
+    contended = {tid: scored_counts[tid] / cont_elapsed for tid in all_ids}
+    cont_lat = phase_latency()
+
+    snap = rt.metrics.snapshot()
+    shed = {tid: snap.get(f"flow.rejected:{tid}", 0.0) for tid in all_ids}
+    await rt.stop()
+
+    ratios = {tid: (contended[tid] / baseline[tid]) if baseline[tid] else 0.0
+              for tid in good_ids}
+    worst = min(ratios.values()) if ratios else 0.0
+    return {
+        "metric": "overload_goodput_retention",
+        # the acceptance number: worst well-behaved tenant's contended
+        # goodput as a fraction of its own no-hog baseline (target ≥0.9)
+        "value": round(worst, 4),
+        "unit": "fraction_of_baseline",
+        "vs_baseline": round(worst, 4),
+        "quota_events_per_sec": quota,
+        "hog_offered_multiple": args.hog_multiple,
+        "hog_goodput": round(contended["hog"], 1),
+        # ≈1.0 = capped AT quota (burst refill allows slight overshoot)
+        "hog_vs_quota": round(contended["hog"] / quota, 3),
+        "well_behaved_baseline": {t: round(v, 1)
+                                  for t, v in baseline.items()},
+        "well_behaved_contended": {t: round(contended[t], 1)
+                                   for t in good_ids},
+        "goodput_ratios": {t: round(v, 4) for t, v in ratios.items()},
+        "shed_events": {t: int(v) for t, v in shed.items()},
+        "offered": {t: s["offered"] for t, s in cont_stats.items()},
+        "accepted": {t: s["accepted"] for t, s in cont_stats.items()},
+        "baseline_latency": base_lat,
+        "contended_latency": cont_lat,
+        "baseline_offered": {t: s["offered"]
+                             for t, s in base_stats.items()},
+        "tenants": len(all_ids),
+        "fleet_devices_per_tenant": devices,
+        "model": "zscore",
+        "seconds": round(cont_elapsed, 2),
+        "platform": platform, "device_kind": device_kind, "chips": n_chips,
+    }
+
+
 async def run_bench(args) -> dict:
     import jax
 
@@ -952,6 +1162,7 @@ async def run_bench(args) -> dict:
     for nm, h in zip(("admit", "batch", "device", "sink"), stage_hists):
         if h is not None:
             breakdown[nm] = {"p50_ms": round(h.quantile(0.5) * 1e3, 3),
+                             "p95_ms": round(h.quantile(0.95) * 1e3, 3),
                              "p99_ms": round(h.quantile(0.99) * 1e3, 3)}
 
     # MFU: achieved model FLOP/s at the saturation rate vs chip peak
@@ -1097,6 +1308,22 @@ def main() -> None:
     parser.add_argument("--gnn", action="store_true",
                         help="config-5 bench: fleet graph build + GNN "
                              "risk scoring at fleet sizes 1k/10k")
+    parser.add_argument("--overload", action="store_true",
+                        help="flow-control isolation bench: one hog "
+                             "tenant at 10x quota + N well-behaved "
+                             "tenants; artifact records per-tenant "
+                             "goodput, shed counts, and p99 per phase")
+    parser.add_argument("--overload-tenants", type=int, default=3,
+                        help="number of well-behaved tenants beside the "
+                             "hog")
+    parser.add_argument("--overload-devices", type=int, default=1024,
+                        help="fleet devices per tenant in --overload")
+    parser.add_argument("--quota", type=float, default=5000.0,
+                        help="per-tenant ingress quota (events/sec) in "
+                             "--overload")
+    parser.add_argument("--hog-multiple", type=float, default=10.0,
+                        help="hog offered load as a multiple of its "
+                             "quota")
     parser.add_argument("--probe-horizon", type=float, default=600.0,
                         help="supervisor: total seconds to keep re-probing "
                              "a dead/hung backend before giving up")
@@ -1172,6 +1399,8 @@ def main() -> None:
         result = (run_train_bench(args) if args.train
                   else run_gnn_bench(args) if args.gnn
                   else asyncio.run(run_split_bench(args)) if args.split
+                  else asyncio.run(run_overload_bench(args))
+                  if args.overload
                   else asyncio.run(run_bench(args)))
     except BaseException as exc:  # noqa: BLE001 - the artifact must parse
         traceback.print_exc()

@@ -8,6 +8,7 @@ rebuild ships one:
   swx bench [...]                                  run the benchmark
   swx demo                                         run + simulate + score, one process
   swx dlq list|replay --tenant T                   inspect/replay dead letters
+  swx quota show|set --tenant T                    flow-control quotas
 
 `run` starts every service, creates tenants from the YAML (or a default
 tenant), and serves REST until interrupted.
@@ -275,7 +276,8 @@ async def cmd_run(args) -> int:
 
         assert isinstance(rt.bus, EventBus)  # enforced at arg parse
         kafka_ep = KafkaEndpoint(rt.bus, port=args.kafka_port,
-                                 auto_create_limit=args.kafka_auto_topics)
+                                 auto_create_limit=args.kafka_auto_topics,
+                                 flow=rt.flow, naming=rt.naming)
         try:
             await kafka_ep.start()
         except OSError as exc:
@@ -402,6 +404,49 @@ async def _dlq_request(args, basic: str) -> int:
         return 1
     print(json.dumps(out, indent=2))
     return 0
+
+
+async def cmd_quota(args) -> int:
+    """Inspect/set a tenant's flow-control quota over the REST API
+    (`swx quota show` / `swx quota set --rate R [--burst B] [--weight W]`)."""
+    import base64
+
+    basic = base64.b64encode(
+        f"{args.user}:{args.password}".encode()).decode()
+    try:
+        status, out = await _http_json(
+            "POST", args.host, args.port, "/api/jwt",
+            headers={"Authorization": f"Basic {basic}"})
+        if status != 200:
+            print(f"swx quota: authentication failed ({status}): {out}",
+                  file=sys.stderr)
+            return 1
+        headers = {"Authorization": f"Bearer {out['token']}"}
+        path = f"/api/tenants/{args.tenant}/quota"
+        if args.action == "show":
+            status, out = await _http_json("GET", args.host, args.port,
+                                           path, headers=headers)
+        else:  # set
+            body = {k: v for k, v in (("rate", args.rate),
+                                      ("burst", args.burst),
+                                      ("weight", args.weight))
+                    if v is not None}
+            if not body:
+                print("swx quota set: pass at least one of --rate/--burst/"
+                      "--weight", file=sys.stderr)
+                return 2
+            status, out = await _http_json("PUT", args.host, args.port,
+                                           path, headers=headers, body=body)
+        if status != 200:
+            print(f"swx quota: {args.action} failed ({status}): {out}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(out, indent=2))
+        return 0
+    except (OSError, asyncio.TimeoutError, IndexError, ValueError) as exc:
+        print(f"swx quota: cannot reach REST at {args.host}:{args.port}: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return 1
 
 
 async def cmd_simulate(args) -> int:
@@ -684,6 +729,21 @@ def main(argv=None) -> int:
     p_dlq.add_argument("--user", default="admin")
     p_dlq.add_argument("--password", default="password")
 
+    p_quota = sub.add_parser("quota", parents=[common],
+                             help="inspect/set a tenant's flow-control "
+                                  "quota via the REST API")
+    p_quota.add_argument("action", choices=["show", "set"])
+    p_quota.add_argument("--host", default="127.0.0.1")
+    p_quota.add_argument("--port", type=int, default=8080, help="REST port")
+    p_quota.add_argument("--tenant", default="default")
+    p_quota.add_argument("--rate", type=float,
+                         help="events/sec (0 = unlimited)")
+    p_quota.add_argument("--burst", type=float, help="burst events")
+    p_quota.add_argument("--weight", type=float,
+                         help="weighted-fair inbound share")
+    p_quota.add_argument("--user", default="admin")
+    p_quota.add_argument("--password", default="password")
+
     p_demo = sub.add_parser("demo", parents=[common], help="one-process end-to-end demo")
     p_demo.add_argument("--devices", type=int, default=1000)
     p_demo.add_argument("--seconds", type=float, default=5.0)
@@ -729,7 +789,7 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", "cpu")
     coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
             "train": cmd_train, "serve-bus": cmd_serve_bus,
-            "dlq": cmd_dlq}[args.cmd]
+            "dlq": cmd_dlq, "quota": cmd_quota}[args.cmd]
     return asyncio.run(coro(args))
 
 

@@ -58,6 +58,25 @@ class InstanceSettings:
     durable_fsync_interval_s: float = 0.2
     durable_segment_bytes: int = 4 << 20
     durable_max_segments: int = 64
+    # flow control (kernel/flow.py): per-tenant ingress quota defaults —
+    # a tenant's `flow:` config section overrides these. rate 0 =
+    # unlimited (admission is then shed-mode-gated only). burst 0 →
+    # max(2×rate, 64). Tenants share inbound processing fairly in
+    # proportion to `weight` whenever `flow_inbound_rate` caps the
+    # instance-wide inbound budget (0 = uncapped).
+    flow_default_rate: float = 0.0
+    flow_default_burst: float = 0.0
+    flow_default_weight: float = 1.0
+    flow_inbound_rate: float = 0.0
+    # overload shed-policy thresholds on scorer-backlog pressure [0..1]:
+    # ok → reject (shed at ingress) → degrade (cheap fallback scorer) →
+    # defer (spool to deferred-events); de-escalation below
+    # threshold × hysteresis (anti-flap)
+    flow_reject_at: float = 0.5
+    flow_degrade_at: float = 0.75
+    flow_defer_at: float = 0.9
+    flow_hysteresis: float = 0.8
+    flow_dlq_rate_max: float = 50.0   # DLQ events/s mapping to pressure 1.0
     # log level
     log_level: str = "INFO"
 

@@ -423,6 +423,7 @@ class TopicNaming:
     BATCH_ELEMENTS = "batch-operation-elements"
     SCORED_EVENTS = "scored-events"              # new: model-plane output
     DEAD_LETTER = "dead-letter-events"           # poison-record quarantine
+    DEFERRED_EVENTS = "deferred-events"          # overload spool (flow.py)
     # instance-scoped
     TENANT_MODEL_UPDATES = "tenant-model-updates"
     INSTANCE_LOGS = "instance-logs"
@@ -435,3 +436,15 @@ class TopicNaming:
 
     def instance_topic(self, function: str) -> str:
         return f"{self.instance_id}.instance.{function}"
+
+    def split_tenant_topic(self, topic: str):
+        """→ (tenant_id, function) for a tenant-scoped topic of THIS
+        instance, else None (foreign/instance-scoped topics). The Kafka
+        endpoint uses this to attribute a Produce to a tenant quota."""
+        prefix = f"{self.instance_id}.tenant."
+        if not topic.startswith(prefix):
+            return None
+        tenant_id, _, function = topic[len(prefix):].partition(".")
+        if not tenant_id or not function:
+            return None
+        return tenant_id, function

@@ -84,10 +84,18 @@ def list_dead_letters(bus, dlq_topic: str, limit: int = 100) -> list:
 
 async def replay_dead_letters(bus, dlq_topic: str, *,
                               limit: Optional[int] = None,
-                              metrics=None) -> int:
+                              metrics=None, flow=None,
+                              tenant_id: Optional[str] = None) -> int:
     """Re-produce dead letters onto their original topics; returns the
     count replayed. Progress is committed under a per-topic replay
-    group, so a second replay call continues where the last stopped."""
+    group, so a second replay call continues where the last stopped.
+
+    When `flow` + `tenant_id` are given, each replayed batch is charged
+    against the tenant's ingress quota exactly like live traffic — a
+    replay can NOT bypass flow control and re-trigger the overload that
+    dead-lettered the records in the first place. An over-quota replay
+    stops early (the record stays uncommitted, so a later call resumes
+    with it) and reports how far it got."""
     consumer = bus.subscribe(dlq_topic, group=f"{dlq_topic}.replay")
     replayed = 0
     try:
@@ -102,6 +110,16 @@ async def replay_dead_letters(bus, dlq_topic: str, *,
                 break
             entry = records[0].value
             if isinstance(entry, dict) and "original_topic" in entry:
+                if flow is not None and tenant_id is not None:
+                    try:
+                        cost = float(len(entry["value"]))
+                    except TypeError:
+                        cost = 1.0
+                    if not flow.admit_ingress(tenant_id,
+                                              max(cost, 1.0)).admitted:
+                        logger.info("dlq replay for %s paused over quota "
+                                    "after %d records", tenant_id, replayed)
+                        break   # NOT committed: the next replay resumes here
                 await bus.produce(entry["original_topic"], entry["value"],
                                   key=entry.get("key"))
                 replayed += 1

@@ -182,10 +182,20 @@ class KafkaEndpoint:
     `EventBus` (kernel/bus.py)."""
 
     def __init__(self, bus, host: str = "127.0.0.1", port: int = 0,
-                 node_id: int = 0, auto_create_limit: int = 256):
+                 node_id: int = 0, auto_create_limit: int = 256,
+                 flow=None, naming=None):
         self.bus = bus
         self.host, self.port = host, port
         self.node_id = node_id
+        # per-tenant flow control (kernel/flow.py) + topic naming: when
+        # both are set, Produce to a tenant-scoped topic charges that
+        # tenant's quota and over-quota produces are answered with Kafka
+        # quota semantics — records accepted, response carries
+        # throttle_time_ms (Produce v1; v0 has no field, so v0 clients
+        # are simply not throttled-visible)
+        self.flow = flow
+        self.naming = naming
+        self.throttled = 0
         # unauthenticated peers may request arbitrary topic names; cap
         # how many NEW topics this endpoint will create on their behalf
         # (0 = no auto-create at all) so a typo'd or hostile client
@@ -262,7 +272,8 @@ class KafkaEndpoint:
 
     async def _dispatch(self, api_key: int, api_version: int,
                         r: _Reader) -> Optional[bytes]:
-        if api_version != 0:
+        if api_version != 0 and not (api_key == API_PRODUCE
+                                     and api_version == 1):
             if api_key == API_VERSIONS:
                 # error 35 (UNSUPPORTED_VERSION) + the served list: the
                 # standard negotiation path — clients retry with v0
@@ -276,7 +287,9 @@ class KafkaEndpoint:
         if api_key == API_METADATA:
             return self._metadata(r)
         if api_key == API_PRODUCE:
-            return await self._produce(r)
+            # v1 request body is identical to v0; the response appends
+            # throttle_time_ms — the field quota enforcement rides on
+            return await self._produce(r, api_version)
         if api_key == API_FETCH:
             return await self._fetch(r)
         if api_key == API_LIST_OFFSETS:
@@ -294,7 +307,7 @@ class KafkaEndpoint:
     # -- apis ---------------------------------------------------------------
 
     def _api_versions(self) -> bytes:
-        served = [(API_PRODUCE, 0, 0), (API_FETCH, 0, 0),
+        served = [(API_PRODUCE, 0, 1), (API_FETCH, 0, 0),
                   (API_LIST_OFFSETS, 0, 0), (API_METADATA, 0, 0),
                   (API_OFFSET_COMMIT, 0, 0), (API_OFFSET_FETCH, 0, 0),
                   (API_FIND_COORDINATOR, 0, 0), (API_VERSIONS, 0, 0)]
@@ -339,12 +352,28 @@ class KafkaEndpoint:
                 for p in range(len(parts))]))
         return _arr([self._broker_entry()]) + _arr(topics)
 
-    async def _produce(self, r: _Reader):
+    def _charge_quota(self, topic_name: str, n: int) -> float:
+        """Charge `n` produced EVENTS against the owning tenant's quota;
+        returns the throttle hint in seconds (0.0 = within quota). Kafka
+        quota semantics: the records are ACCEPTED either way — the
+        response's throttle_time_ms tells the client to back off."""
+        if self.flow is None or self.naming is None or n == 0:
+            return 0.0
+        parsed = self.naming.split_tenant_topic(topic_name)
+        if parsed is None:
+            return 0.0
+        # charge_produced, not admit_ingress: the records below are
+        # delivered regardless, so they must land in flow.admitted /
+        # flow.throttled — flow.rejected means dropped traffic
+        return self.flow.charge_produced(parsed[0], n)
+
+    async def _produce(self, r: _Reader, api_version: int = 0):
         from sitewhere_tpu.kernel import codec
 
         acks = r.i16()
         r.i32()  # timeout
         topics_out = []
+        throttle_s = 0.0
         for _ in range(r.array()):
             name = r.string() or ""
             parts_out = []
@@ -367,11 +396,24 @@ class KafkaEndpoint:
                     parts_out.append(struct.pack(
                         ">ihq", pid, ERR_CORRUPT_MESSAGE, -1))
                     continue
+                # decode BEFORE charging: the quota is in events, and a
+                # codec batch carries many events per Kafka message — a
+                # per-message charge would let a batching tenant bypass
+                # its quota by the batch factor (every other ingress
+                # edge charges per decoded event)
+                decoded = []
+                n_events = 0
                 for key, value in entries:
                     try:
                         obj = codec.decode(value) if value else value
                     except Exception:  # noqa: BLE001 - foreign producer
                         obj = value
+                    n_events += (len(obj)
+                                 if hasattr(obj, "device_index") else 1)
+                    decoded.append((key, obj))
+                throttle_s = max(throttle_s,
+                                 self._charge_quota(name, n_events))
+                for key, obj in decoded:
                     await self.bus.produce(
                         name, obj, partition=pid,
                         key=key.decode("utf-8", "replace")
@@ -379,11 +421,16 @@ class KafkaEndpoint:
                     self.produced += 1
                 parts_out.append(struct.pack(">ihq", pid, ERR_NONE, base))
             topics_out.append(_s(name) + _arr(parts_out))
+        if throttle_s > 0:
+            self.throttled += 1
         if acks == 0:
             # fire-and-forget contract: real brokers send NO response;
             # an unsolicited frame would desync the client's pipeline
             return ...
-        return _arr(topics_out)
+        body = _arr(topics_out)
+        if api_version >= 1:
+            body += struct.pack(">i", min(int(throttle_s * 1000), 30_000))
+        return body
 
     async def _fetch(self, r: _Reader) -> bytes:
         from sitewhere_tpu.kernel import codec

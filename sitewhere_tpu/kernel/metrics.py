@@ -102,16 +102,22 @@ class Histogram:
 
     def quantile(self, q: float) -> float:
         """Estimate of the q-quantile: linear interpolation within the
-        bucket that crosses the target rank (upper-bounded by `_max`)."""
-        if self.count == 0:
+        bucket that crosses the target rank (upper-bounded by `_max`).
+
+        Total function: an empty histogram returns 0.0 (readouts run on
+        freshly-reset histograms at phase boundaries — they must never
+        raise), q is clamped into [0, 1], and q=0 reads the observed
+        minimum bucket edge rather than an upper bound."""
+        if self.count == 0 or not math.isfinite(q):
             return 0.0
-        target = math.ceil(q * self.count)
+        q = min(max(q, 0.0), 1.0)
+        target = max(math.ceil(q * self.count), 1)
         seen = 0
         for i, c in enumerate(self.counts):
-            if seen + c >= target:
+            if c and seen + c >= target:
                 hi = self.buckets[i] if i < len(self.buckets) else self._max
                 lo = self.buckets[i - 1] if 0 < i <= len(self.buckets) else 0.0
-                frac = (target - seen) / c if c else 1.0
+                frac = (target - seen) / c
                 return min(lo + frac * (hi - lo), self._max)
             seen += c
         return self._max
@@ -197,14 +203,75 @@ class MetricsRegistry:
             elif isinstance(m, Histogram):
                 out[name] = {
                     "count": m.count, "mean": m.mean,
-                    "p50": m.quantile(0.50), "p99": m.quantile(0.99),
+                    "p50": m.quantile(0.50), "p95": m.quantile(0.95),
+                    "p99": m.quantile(0.99),
                     "max": m._max,
                 }
         return out
 
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        """Prometheus metric names allow [a-zA-Z0-9_:] only."""
+        return "".join(ch if (ch.isalnum() or ch in "_:") else "_"
+                       for ch in name)
+
+    def prometheus_text(self) -> str:
+        """The registry in Prometheus exposition format (dependency-free
+        — the `/metrics` text a scraper would read). Counters/gauges map
+        directly; histograms export as summaries (quantiles + _count +
+        _sum); meters as gauges of the 10 s rate."""
+        ns = self._prom_name(self.namespace)
+        lines: list[str] = []
+        for name, m in sorted(self._metrics.items()):
+            mn = f"{ns}_{self._prom_name(m.name)}"
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {mn} counter")
+                lines.append(f"{mn} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {mn} gauge")
+                lines.append(f"{mn} {m.value}")
+            elif isinstance(m, Meter):
+                lines.append(f"# TYPE {mn} gauge")
+                lines.append(f"{mn} {m.rate(10.0)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {mn} summary")
+                for q in (0.5, 0.95, 0.99):
+                    lines.append(
+                        f'{mn}{{quantile="{q}"}} {m.quantile(q)}')
+                lines.append(f"{mn}_sum {m.sum}")
+                lines.append(f"{mn}_count {m.count}")
+        return "\n".join(lines) + "\n"
+
     def export_prometheus(self, port: int = 9090) -> bool:  # pragma: no cover
-        """Start a prometheus scrape endpoint mirroring this registry."""
+        """Start a prometheus scrape endpoint mirroring this registry
+        (values are collected live from the internal registry at scrape
+        time — the internal registry stays the source of truth)."""
         if _prom is None:
             return False
+        registry = self
+
+        class _Collector:
+            def collect(self):
+                from prometheus_client.core import (
+                    CounterMetricFamily,
+                    GaugeMetricFamily,
+                    SummaryMetricFamily,
+                )
+
+                ns = registry._prom_name(registry.namespace)
+                for name, m in sorted(registry._metrics.items()):
+                    mn = f"{ns}_{registry._prom_name(m.name)}"
+                    if isinstance(m, Counter):
+                        yield CounterMetricFamily(mn, name, value=m.value)
+                    elif isinstance(m, Gauge):
+                        yield GaugeMetricFamily(mn, name, value=m.value)
+                    elif isinstance(m, Meter):
+                        yield GaugeMetricFamily(mn, name, value=m.rate(10.0))
+                    elif isinstance(m, Histogram):
+                        yield SummaryMetricFamily(mn, name,
+                                                  count_value=m.count,
+                                                  sum_value=m.sum)
+
+        _prom.REGISTRY.register(_Collector())
         _prom.start_http_server(port)
         return True

@@ -72,6 +72,9 @@ class TenantEngine(LifecycleComponent):
         every consuming loop routes through. Never raises."""
         from sitewhere_tpu.kernel.dlq import quarantine
 
+        # the DLQ rate feeds the tenant's overload pressure: a poison
+        # storm escalates shedding even before the scorer backlog builds
+        self.runtime.flow.note_dead_letter(self.tenant_id)
         await quarantine(self.runtime.bus, self.dead_letter_topic, record,
                          exc, stage, metrics=self.runtime.metrics,
                          tenant_id=self.tenant_id)
@@ -229,6 +232,11 @@ class ServiceRuntime(LifecycleComponent):
             self.add_child(self.bus)
         else:
             self._external_bus = self.bus
+        # per-tenant flow control (kernel/flow.py): quotas, weighted-fair
+        # inbound admission, overload shedding — every ingress edge and
+        # the rule-processing shed path consult this
+        from sitewhere_tpu.kernel.flow import FlowController
+        self.flow = FlowController(settings, self.metrics)
         self.services: dict[str, Service] = {}
         self.remotes: dict[str, Any] = {}   # identifier -> RemoteService
         self.tenants: dict[str, TenantConfig] = {}
@@ -269,6 +277,7 @@ class ServiceRuntime(LifecycleComponent):
         self.faults = injector
         if hasattr(self.bus, "faults"):
             self.bus.faults = injector
+        self.flow.faults = injector
         return injector
 
     def api(self, identifier: str) -> Any:
@@ -319,6 +328,7 @@ class ServiceRuntime(LifecycleComponent):
     async def add_tenant(self, tenant: TenantConfig, *, timeout: float = 60.0) -> None:
         """Register a tenant and broadcast creation (reference: §3.5)."""
         self.tenants[tenant.tenant_id] = tenant
+        self.flow.configure_tenant(tenant)
         self.tenant_epoch += 1
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
@@ -327,6 +337,7 @@ class ServiceRuntime(LifecycleComponent):
 
     async def update_tenant(self, tenant: TenantConfig) -> None:
         self.tenants[tenant.tenant_id] = tenant
+        self.flow.configure_tenant(tenant)
         self.tenant_epoch += 1
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
@@ -337,6 +348,7 @@ class ServiceRuntime(LifecycleComponent):
         tenant = self.tenants.pop(tenant_id, None)
         if tenant is None:
             return
+        self.flow.drop_tenant(tenant_id)
         self.tenant_epoch += 1
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),

@@ -55,10 +55,12 @@ logger = logging.getLogger(__name__)
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[dict] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or {}   # e.g. Retry-After on 429
 
 
 class Request:
@@ -149,6 +151,7 @@ class RestServer(LifecycleComponent):
                         break
                     k, _, v = h.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
+                extra: dict = {}
                 try:
                     length = int(headers.get("content-length", 0) or 0)
                     if length < 0:
@@ -163,13 +166,16 @@ class RestServer(LifecycleComponent):
                     length = None
                 if length is not None:
                     body = await reader.readexactly(length) if length else b""
-                    status, ctype, payload = await self._dispatch(
+                    status, ctype, payload, extra = await self._dispatch(
                         method, target, headers, body)
                 conn = "keep-alive" if length is not None else "close"
+                extra_lines = "".join(f"{k}: {v}\r\n"
+                                      for k, v in extra.items())
                 writer.write(
                     f"HTTP/1.1 {status} {_reason(status)}\r\n"
                     f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(payload)}\r\n"
+                    f"{extra_lines}"
                     f"Connection: {conn}\r\n\r\n".encode() + payload)
                 await writer.drain()
                 if length is None:  # unread request body: can't reuse conn
@@ -184,7 +190,7 @@ class RestServer(LifecycleComponent):
                 pass
 
     async def _dispatch(self, method: str, target: str, headers: dict,
-                        body: bytes) -> tuple[int, str, bytes]:
+                        body: bytes) -> tuple[int, str, bytes, dict]:
         parsed = urlparse(target)
         path = parsed.path.rstrip("/") or "/"
         query = parse_qs(parsed.query)
@@ -205,16 +211,17 @@ class RestServer(LifecycleComponent):
                 req.params = match.groupdict()
                 result = await handler(req)
                 if isinstance(result, tuple):  # (content_type, bytes)
-                    return 200, result[0], result[1]
-                return 200, "application/json", _dumps(result)
+                    return 200, result[0], result[1], {}
+                return 200, "application/json", _dumps(result), {}
             raise HttpError(404, f"no route {method} {path}")
         except HttpError as exc:
             return exc.status, "application/json", _dumps(
-                {"error": exc.message, "status": exc.status})
+                {"error": exc.message, "status": exc.status}), exc.headers
         except Exception as exc:  # noqa: BLE001 - don't leak stacks to clients
             logger.exception("REST handler error for %s %s", method, target)
             return 500, "application/json", _dumps(
-                {"error": f"internal error: {type(exc).__name__}", "status": 500})
+                {"error": f"internal error: {type(exc).__name__}",
+                 "status": 500}), {}
 
     def _authenticate(self, headers: dict, path: str,
                       method: str) -> Optional[AuthContext]:
@@ -331,6 +338,11 @@ class RestServer(LifecycleComponent):
         r("GET", r"/api/tenants", self.list_tenants)
         r("POST", r"/api/tenants", self.create_tenant, AUTH_ADMIN_TENANTS)
         r("GET", r"/api/tenants/(?P<token>[^/]+)", self.get_tenant)
+        # flow-control quotas (kernel/flow.py): inspect/set at runtime
+        r("GET", r"/api/tenants/(?P<token>[^/]+)/quota",
+          self.get_tenant_quota)
+        r("PUT", r"/api/tenants/(?P<token>[^/]+)/quota",
+          self.put_tenant_quota, AUTH_ADMIN_TENANTS)
         r("PUT", r"/api/tenants/(?P<token>[^/]+)", self.update_tenant,
           AUTH_ADMIN_TENANTS)
         r("DELETE", r"/api/tenants/(?P<token>[^/]+)", self.delete_tenant,
@@ -726,13 +738,22 @@ class RestServer(LifecycleComponent):
         from sitewhere_tpu.kernel.bus import TopicNaming
 
         idx = self._assignment_device_index(req)
+        tenant_id = self._tenant_id(req)
+        # flow control: REST ingest charges the tenant quota like every
+        # other ingress edge; over quota → 429 + Retry-After
+        decision = self.runtime.flow.admit_ingress(tenant_id, 1)
+        if not decision.admitted:
+            raise HttpError(
+                429, f"tenant {tenant_id!r} over quota ({decision.reason})",
+                headers={"Retry-After":
+                         str(max(int(decision.retry_after + 0.999), 1))})
         b = req.json()
         if b.get("eventDate", 0) is None:
             # explicit JSON null = "unset" (common serializer output);
             # coalesce to now in ONE place for every event builder
             del b["eventDate"]
         try:
-            batch = build(idx, b, self._tenant_id(req))
+            batch = build(idx, b, tenant_id)
         except (TypeError, ValueError) as exc:
             raise HttpError(400, f"bad event payload: {exc}") from exc
         sources = self._engine(req, "event-sources")
@@ -740,6 +761,57 @@ class RestServer(LifecycleComponent):
             sources.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED), batch,
             key="rest")
         return {"accepted": 1}
+
+    # -- handlers: flow-control quotas -------------------------------------
+
+    async def get_tenant_quota(self, req: Request):
+        """Live flow-control state for a tenant: quota, remaining burst
+        tokens, shed mode/pressure, and admission counters."""
+        tenant = req.params["token"]
+        if tenant not in self.runtime.tenants:
+            raise HttpError(404, f"unknown tenant {tenant!r}")
+        return self.runtime.flow.quota(tenant)
+
+    async def put_tenant_quota(self, req: Request):
+        """Runtime quota update (rate events/s, burst events, fair-share
+        weight); takes effect immediately, no engine respin. rate 0 =
+        unlimited."""
+        tenant = req.params["token"]
+        if tenant not in self.runtime.tenants:
+            raise HttpError(404, f"unknown tenant {tenant!r}")
+        b = req.json()
+        kwargs = {}
+        for key in ("rate", "burst", "weight"):
+            if key in b:
+                try:
+                    kwargs[key] = float(b[key])
+                except (TypeError, ValueError) as exc:
+                    raise HttpError(400, f"{key} must be a number") from exc
+        if "mode" in b:
+            # operator override: pin a shed mode ("auto" resumes the
+            # controller) — the overloaded-tenant runbook's lever
+            try:
+                self.runtime.flow.force_mode(tenant, b["mode"])
+            except ValueError as exc:
+                raise HttpError(400, str(exc)) from exc
+        elif not kwargs:
+            raise HttpError(400, "body needs rate, burst, weight, or mode")
+        if kwargs:
+            self.runtime.flow.set_quota(tenant, **kwargs)
+            # persist the EFFECTIVE quota into the runtime's tenant
+            # config: a later tenant update re-applies configure_tenant,
+            # which would otherwise silently revert an operator-set
+            # quota. Persisting the request body instead of the read-back
+            # would re-introduce the stale-burst bug (a rate-only PUT
+            # rescales the live burst; the old section value must not
+            # survive it). In-place update — no broadcast, no respin.
+            q = self.runtime.flow.quota(tenant)
+            cfg = self.runtime.tenants.get(tenant)
+            if cfg is not None:
+                self.runtime.tenants[tenant] = cfg.with_section(
+                    "flow", {"rate": q["rate"], "burst": q["burst"],
+                             "weight": q["weight"]})
+        return self.runtime.flow.quota(tenant)
 
     async def add_measurement(self, req: Request):
         from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
@@ -925,9 +997,12 @@ class RestServer(LifecycleComponent):
         from sitewhere_tpu.kernel.dlq import replay_dead_letters
 
         limit = req.json().get("limit")
+        # replay passes through flow control like live traffic (no
+        # bypass that lets a replay re-trigger the original overload)
         n = await replay_dead_letters(
             self.runtime.bus, self._dlq_topic(req), limit=limit,
-            metrics=self.runtime.metrics)
+            metrics=self.runtime.metrics, flow=self.runtime.flow,
+            tenant_id=self._tenant_id(req))
         return {"replayed": n}
 
     # -- handlers: areas/customers/zones/assets ----------------------------
@@ -1299,7 +1374,8 @@ class RestServer(LifecycleComponent):
 def _reason(status: int) -> str:
     return {200: "OK", 400: "Bad Request", 401: "Unauthorized",
             403: "Forbidden", 404: "Not Found", 409: "Conflict",
-            413: "Payload Too Large", 500: "Internal Server Error",
+            413: "Payload Too Large", 429: "Too Many Requests",
+            500: "Internal Server Error",
             503: "Service Unavailable"}.get(status, "Unknown")
 
 

@@ -370,14 +370,25 @@ class _Conn:
     async def complete_publish(self, channel: int, ch: dict) -> None:
         pending = ch.pop("pending")
         ch["publishes"] += 1
+        accepted = True
         try:
-            await self.listener.on_message(pending["key"], pending["body"],
-                                           self.user or self.peer)
+            accepted = await self.listener.on_message(
+                pending["key"], pending["body"], self.user or self.peer)
         except Exception:
             logger.exception("amqp: on_message failed")
         if ch["confirm"]:
-            await self.send_method(channel, _method(
-                BASIC, 80, struct.pack(">QB", ch["publishes"], 0)))
+            if accepted is False:
+                # over-quota flow control: basic.nack (method 120) is the
+                # confirm-mode contract for "broker refused this publish"
+                self.listener.rejected += 1
+                await self.send_method(channel, _method(
+                    BASIC, 120, struct.pack(">QB", ch["publishes"], 0)))
+            else:
+                await self.send_method(channel, _method(
+                    BASIC, 80, struct.pack(">QB", ch["publishes"], 0)))
+        elif accepted is False:
+            # fire-and-forget publisher: nothing to answer; count only
+            self.listener.rejected += 1
 
 
 class AmqpListener:
@@ -394,6 +405,8 @@ class AmqpListener:
         self.frame_max = frame_max
         self.channel_max = channel_max
         self.heartbeat = heartbeat
+        # publishes refused by the ingest hook (over-quota flow control)
+        self.rejected = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set[asyncio.StreamWriter] = set()
 

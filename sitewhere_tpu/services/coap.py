@@ -39,7 +39,9 @@ CODE_BAD_REQUEST = 0x80    # 4.00
 CODE_UNAUTHORIZED = 0x81   # 4.01
 CODE_NOT_FOUND = 0x84      # 4.04
 CODE_NOT_ALLOWED = 0x85    # 4.05
+CODE_TOO_MANY = 0x9D       # 4.29 Too Many Requests (RFC 8516)
 OPT_URI_PATH = 11
+OPT_MAX_AGE = 14
 OPT_URI_QUERY = 15
 
 # CON dedup horizon (RFC 7252 EXCHANGE_LIFETIME is 247 s; constrained
@@ -96,10 +98,15 @@ def parse_message(data: bytes):
 
 
 def build_message(mtype: int, code: int, mid: int, token: bytes = b"",
-                  payload: bytes = b"") -> bytes:
+                  payload: bytes = b"", max_age: Optional[int] = None) -> bytes:
     out = bytearray([(1 << 6) | (mtype << 4) | len(token), code])
     out += mid.to_bytes(2, "big")
     out += token
+    if max_age is not None:
+        # Max-Age (option 14, uint seconds): RFC 8516 uses it on 4.29 as
+        # the retry-after hint
+        v = max_age.to_bytes(max((max_age.bit_length() + 7) // 8, 1), "big")
+        out += _encode_option(OPT_MAX_AGE, v)
     if payload:
         out += b"\xff" + payload
     return bytes(out)
@@ -110,10 +117,16 @@ class CoapListener(asyncio.DatagramProtocol):
     task) for every accepted POST."""
 
     def __init__(self, on_payload, host: str = "127.0.0.1", port: int = 0,
-                 path: str = "telemetry", secret: Optional[str] = None):
+                 path: str = "telemetry", secret: Optional[str] = None,
+                 admit=None):
         self.on_payload = on_payload
         self.host, self.port = host, port
         self.path = path
+        # flow-control hook: `admit(payload) -> float` returns 0.0 to
+        # accept or a retry-after in seconds; rejections answer 4.29
+        # Too Many Requests (RFC 8516) with Max-Age as the hint
+        self.admit = admit
+        self.over_quota = 0
         # shared-secret ingest auth: when set, POSTs must carry a
         # Uri-Query option `token=<secret>` or they get 4.01 and are
         # never decoded. DEPLOYMENT CAVEAT: CoAP here is cleartext UDP
@@ -224,6 +237,15 @@ class CoapListener(asyncio.DatagramProtocol):
                 self._reply_con(addr, mid, build_message(
                     TYPE_ACK, CODE_BAD_REQUEST, mid, token))
             return
+        if self.admit is not None:
+            retry_after = self.admit(payload)
+            if retry_after > 0:
+                self.over_quota += 1
+                if mtype == TYPE_CON:
+                    self._reply_con(addr, mid, build_message(
+                        TYPE_ACK, CODE_TOO_MANY, mid, token,
+                        max_age=max(int(retry_after + 0.999), 1)))
+                return
         self.accepted += 1
         if mtype == TYPE_CON:
             # piggybacked ACK: decode outcomes are the pipeline's story

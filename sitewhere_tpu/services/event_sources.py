@@ -53,6 +53,22 @@ class EventDecoder(Protocol):
     def decode(self, payload: bytes, ctx: BatchContext) -> list: ...
 
 
+def estimate_payload_events(payload: bytes) -> int:
+    """Cheap event-count estimate for quota charging BEFORE decode: SWB1
+    headers carry the batch count (one unpack, no array work); anything
+    else (JSON, scripted framings) charges 1 per publish. Over-charging
+    is impossible; JSON batches under-charge, which only softens — never
+    bypasses — the quota."""
+    if len(payload) >= _HEADER.size:
+        try:
+            magic, _mt, _flags, n = _HEADER.unpack_from(payload, 0)
+            if magic == MAGIC:
+                return max(int(n), 1)
+        except Exception:  # noqa: BLE001 - estimation must never raise
+            pass
+    return 1
+
+
 class Swb1Decoder:
     """Columnar fast path (reference analog: ProtobufDeviceEventDecoder)."""
 
@@ -183,13 +199,22 @@ class QueueEventReceiver(BackgroundTaskComponent):
         self.decoder = decoder
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
 
-    async def submit(self, payload: bytes) -> None:
+    async def submit(self, payload: bytes) -> bool:
+        # quota charge at arrival (the in-proc analog of a protocol
+        # error): a rejected payload never enters the queue, and the
+        # caller learns it was shed
+        if self.engine.admit_ingress(payload) > 0:
+            return False
         # ingest time is stamped at arrival so queue wait under load is
         # part of measured end-to-end latency (no flattering p99s)
         await self.queue.put((payload, time.monotonic()))
+        return True
 
-    def submit_nowait(self, payload: bytes) -> None:
+    def submit_nowait(self, payload: bytes) -> bool:
+        if self.engine.admit_ingress(payload) > 0:
+            return False
         self.queue.put_nowait((payload, time.monotonic()))
+        return True
 
     async def _run(self) -> None:
         while True:
@@ -234,6 +259,11 @@ class TcpEventReceiver(BackgroundTaskComponent):
                                    " connection", self.name, length, self.max_frame)
                     break
                 payload = await reader.readexactly(length)
+                if self.engine.admit_ingress(payload) > 0:
+                    # SWB1 has no response channel: the over-quota frame
+                    # is dropped (counted in flow.rejected); the gateway
+                    # protocol's backpressure is TCP itself
+                    continue
                 await self.engine.process_payload(payload, self.name, self.decoder,
                                                   ingest_monotonic=time.monotonic())
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -323,10 +353,18 @@ class MqttEventReceiver(BackgroundTaskComponent):
         return self.listener.port
 
     async def _on_publish(self, topic: str, payload: bytes,
-                          client_id: str) -> None:
+                          client_id: str) -> bool:
+        # MQTT 3.1.1 has no per-PUBLISH error code: over-quota publishes
+        # are refused (False → the listener skips peer fan-out and counts
+        # the reject); QoS1/2 still get their PUBACK/PUBREC — transport
+        # acceptance, not pipeline admission — which is the
+        # protocol-appropriate behavior short of disconnecting
+        if self.engine.admit_ingress(payload) > 0:
+            return False
         await self.engine.process_payload(
             payload, f"{self.name}:{topic}", self.decoder,
             ingest_monotonic=time.monotonic())
+        return True
 
     async def _do_start(self, monitor) -> None:
         await self.listener.start()
@@ -372,10 +410,15 @@ class WebSocketEventReceiver(BackgroundTaskComponent):
     def port(self) -> int:
         return self.listener.port
 
-    async def _on_message(self, payload: bytes, client_id: str) -> None:
+    async def _on_message(self, payload: bytes, client_id: str) -> bool:
+        # False → the listener closes the connection with 1013 ("try
+        # again later"), the WebSocket-appropriate over-quota signal
+        if self.engine.admit_ingress(payload) > 0:
+            return False
         await self.engine.process_payload(
             payload, f"{self.name}:{client_id}", self.decoder,
             ingest_monotonic=time.monotonic())
+        return True
 
     async def _do_start(self, monitor) -> None:
         await self.listener.start()
@@ -404,8 +447,14 @@ class CoapEventReceiver(BackgroundTaskComponent):
         self.decoder = decoder
         from sitewhere_tpu.services.coap import CoapListener
 
+        # `admit` answers BEFORE the ACK so an over-quota POST gets the
+        # CoAP-appropriate 4.29 Too Many Requests (RFC 8516) + Max-Age
         self.listener = CoapListener(self._on_payload, host=host, port=port,
-                                     path=path, secret=secret)
+                                     path=path, secret=secret,
+                                     admit=self._admit)
+
+    def _admit(self, payload: bytes) -> float:
+        return self.engine.admit_ingress(payload)
 
     @property
     def port(self) -> int:
@@ -455,10 +504,16 @@ class _BrokerEventReceiver(BackgroundTaskComponent):
         return self.listener.port
 
     async def _on_message(self, key: str, payload: bytes,
-                          source: str) -> None:
+                          source: str) -> bool:
+        # False → AMQP answers confirm-mode publishers with basic.nack;
+        # STOMP answers an ERROR frame (each listener's protocol-
+        # appropriate over-quota signal)
+        if self.engine.admit_ingress(payload) > 0:
+            return False
         await self.engine.process_payload(
             payload, f"{self.name}:{key}", self.decoder,
             ingest_monotonic=time.monotonic())
+        return True
 
     async def _do_start(self, monitor) -> None:
         await self.listener.start()
@@ -515,6 +570,8 @@ class EventSourcesEngine(TenantEngine):
         self._failed_topic = self.tenant_topic(TopicNaming.EVENT_SOURCE_FAILED)
         self._events_in = service.metrics.meter("event_sources.events_received")
         self._decode_failures = service.metrics.counter("event_sources.decode_failures")
+        self._quota_rejected = service.metrics.counter(
+            "event_sources.quota_rejected")
         self.receivers: list[LifecycleComponent] = []
         cfg = tenant.section("event-sources", {"receivers": [{"kind": "queue",
                                                               "decoder": "swb1",
@@ -649,6 +706,22 @@ class EventSourcesEngine(TenantEngine):
             if r.name == name:
                 return r
         raise KeyError(name)
+
+    def admit_ingress(self, payload: bytes) -> float:
+        """Charge this payload against the tenant's ingress quota
+        (kernel/flow.py). Returns 0.0 when admitted, else the seconds a
+        well-behaved publisher should wait before retrying — the caller
+        answers its protocol's over-quota error and must NOT decode or
+        produce the payload."""
+        flow = getattr(self.runtime, "flow", None)
+        if flow is None:
+            return 0.0
+        decision = flow.admit_ingress(self.tenant_id,
+                                      estimate_payload_events(payload))
+        if decision.admitted:
+            return 0.0
+        self._quota_rejected.inc()
+        return max(decision.retry_after, 0.001)
 
     async def process_payload(self, payload: bytes, source: str,
                               decoder: EventDecoder,

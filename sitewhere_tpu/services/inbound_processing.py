@@ -59,12 +59,25 @@ class InboundProcessor(BackgroundTaskComponent):
         dropped = metrics.counter("inbound.events_unregistered")
         consumer = runtime.bus.subscribe(
             decoded_topic, group=f"{tenant_id}.inbound-processing")
+        flow = runtime.flow
         try:
             while True:
                 # re-resolve each round: a tenant update swaps the dm engine
                 if dm_service is not None:
                     dm = dm_service.engines.get(tenant_id, dm)
                 for record in await consumer.poll(max_records=256, timeout=0.2):
+                    # weighted-fair admission (kernel/flow.py): instead of
+                    # handling records FIFO off the bus, each batch is
+                    # admitted through the instance's DRR scheduler — with
+                    # flow_inbound_rate capped, a hog tenant's backlog
+                    # drains in proportion to its weight, not its depth
+                    # (uncapped instances pass through untouched)
+                    if flow is not None:
+                        try:
+                            cost = float(len(record.value))
+                        except TypeError:
+                            cost = 1.0
+                        await flow.admit_fair(tenant_id, max(cost, 1.0))
                     # poison quarantine: a record whose handling raises
                     # goes to the tenant DLQ (with provenance) and the
                     # loop keeps draining — one bad record must never

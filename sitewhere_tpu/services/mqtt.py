@@ -111,6 +111,9 @@ class MqttListener:
         self.authenticate = authenticate
         self.authorize_sub = authorize_sub
         self.sessions: dict[str, MqttSession] = {}
+        # PUBLISHes refused by the ingest hook (over-quota flow control):
+        # 3.1.1 has no negative PUBACK, so refusal = drop + count here
+        self.rejected = 0
         # retained messages (PUBLISH with retain flag): delivered to new
         # matching subscriptions, like any broker; bounded (drop-oldest)
         self.retained: dict[str, bytes] = {}
@@ -313,10 +316,16 @@ class MqttListener:
         """Every accepted PUBLISH goes two ways: into the platform
         pipeline AND out to matching subscribed peers (real broker
         semantics — subscription authorization already gated who may
-        listen where)."""
+        listen where). A publish the ingest hook REFUSES (returns False;
+        over-quota flow control) is rejected wholesale: no retain, no
+        peer fan-out — a throttled tenant must not keep the broker side
+        as a free relay."""
+        accepted = await self.on_publish(topic, payload, session.client_id)
+        if accepted is False:
+            self.rejected += 1
+            return
         if retain:
             self._retain(topic, payload)
-        await self.on_publish(topic, payload, session.client_id)
         await self.publish_to_subscribers(topic, payload,
                                           exclude=session.client_id)
 

@@ -213,6 +213,23 @@ class RuleProcessingEngine(TenantEngine):
             raise RuntimeError("no model session configured")
         return sink.swap_params(params)
 
+    def degraded_score(self, batch: MeasurementBatch) -> ScoredBatch:
+        """Shed-path scoring (flow-control `degrade` mode): the cheap
+        host-side EWMA zscore fallback (kernel/flow.py) — no XLA call, no
+        device round-trip — so an overloaded tenant's events still get
+        approximate anomaly coverage while the real scorer drains."""
+        from sitewhere_tpu.kernel.flow import DegradedZscore
+
+        if getattr(self, "_degraded", None) is None:
+            self._degraded = DegradedZscore()
+        mask = batch.mtype == self.scoring_cfg.mtype
+        dev = batch.device_index[mask]
+        scores = self._degraded.score(dev, batch.value[mask])
+        return ScoredBatch(
+            batch.ctx, dev, scores,
+            scores >= self.scoring_cfg.threshold, batch.ts[mask],
+            model_version=-1)   # -1: degraded fallback, not the model
+
     async def forecast_device(self, device_index: int,
                               include_attention: bool = False) -> dict:
         """Model FORWARD forecast for one device (the query/REST path;
@@ -311,10 +328,33 @@ class RuleProcessor(BackgroundTaskComponent):
         # retention window — records trimmed unread surface here
         lost_counter = runtime.metrics.counter("scoring.bus_records_lost")
         lost_seen = 0
+        # flow control (kernel/flow.py): every poll round feeds the
+        # scorer's backlog/inflight into the tenant's overload state;
+        # the resulting shed mode routes MeasurementBatches to the
+        # scorer (ok), the cheap fallback (degrade), or the deferred
+        # spool (defer) — and reopens ingress when pressure drains
+        flow = runtime.flow
+        deferred_topic = engine.tenant_topic(TopicNaming.DEFERRED_EVENTS)
+        deferred_consumer = None
         # checkpointed commit state: (dispatch_count at snapshot, positions)
         ckpt: Optional[tuple[int, dict]] = None
+        cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
+        if not cap and engine.pool_slot is not None:
+            cap = engine.pool_slot.pool.cfg.backlog_events
+        max_inflight = getattr(getattr(session, "cfg", None),
+                               "max_inflight", 0)
+
+        def report() -> str:
+            if flow is None or sink is None:
+                return "ok"
+            return flow.report_scorer(
+                tenant_id, pending=sink.pending_n, cap=cap,
+                inflight=getattr(sink, "inflight", 0),
+                max_inflight=max_inflight)
+
         try:
             while True:
+                mode = report()
                 if sink is not None and sink.backlogged:
                     # backpressure: the scorer's admission backlog is at
                     # capacity (warmup compile, regrow, overload). Stop
@@ -343,7 +383,34 @@ class RuleProcessor(BackgroundTaskComponent):
                     try:
                         if sink is not None and isinstance(value,
                                                            MeasurementBatch):
-                            sink.admit(value)
+                            # shed routing: flow.shed_mode is also the
+                            # "flow.shed" chaos site — an injected fault
+                            # here quarantines the record like any other
+                            # per-record failure
+                            shed = (flow.shed_mode(tenant_id)
+                                    if flow is not None else "ok")
+                            if shed == "defer" and not hasattr(
+                                    runtime.bus, "peek"):
+                                # wire-bus process: the deferred drain
+                                # below can't run here (no poll_nowait),
+                                # so spooling would strand events until
+                                # retention trims them — degrade instead
+                                shed = "degrade"
+                            if shed == "defer":
+                                # spool to the durable deferred topic;
+                                # drained back through admission once the
+                                # overload clears (below)
+                                await runtime.bus.produce(
+                                    deferred_topic, value, key=record.key)
+                                flow.count_shed(tenant_id, "defer",
+                                                len(value))
+                            elif shed == "degrade":
+                                scored = engine.degraded_score(value)
+                                flow.count_shed(tenant_id, "degrade",
+                                                len(value))
+                                await engine._deliver_scored(scored)
+                            else:
+                                sink.admit(value)
                     except asyncio.CancelledError:
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
@@ -360,6 +427,37 @@ class RuleProcessor(BackgroundTaskComponent):
                     # engine._deliver_scored (publish + alerts) via the
                     # session sink without blocking this consumer loop
                     session.flush_nowait()
+                # refresh the mode AFTER the poll/admit: the pre-poll
+                # value is stale by up to the poll timeout, and a drain
+                # decision made on it could replay records spooled within
+                # the same iteration (found by the forced-defer test)
+                mode = report()
+                if (mode == "ok" and flow is not None and sink is not None
+                        and not sink.backlogged
+                        and hasattr(runtime.bus, "peek")):
+                    # overload cleared: drain a bounded slice of the
+                    # deferred spool back through the scorer. Bounded per
+                    # round so replay cannot re-trigger the overload it
+                    # deferred around; progress commits under a replay
+                    # group so restarts never duplicate.
+                    if deferred_consumer is None:
+                        deferred_consumer = runtime.bus.subscribe(
+                            deferred_topic,
+                            group=f"{tenant_id}.deferred-replay")
+                    replayed = deferred_consumer.poll_nowait(max_records=8)
+                    for rec in replayed:
+                        if not isinstance(rec.value, MeasurementBatch):
+                            continue
+                        try:
+                            sink.admit(rec.value)
+                            flow.count("deferred_replayed", tenant_id,
+                                       len(rec.value))
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as exc:  # noqa: BLE001
+                            await engine.dead_letter(rec, exc, self.path)
+                    if replayed:
+                        deferred_consumer.commit()
                 # at-least-once without commit starvation: when the sink
                 # is idle, commit directly; under steady pipelined load,
                 # snapshot positions whenever nothing sits unflushed and
@@ -379,6 +477,8 @@ class RuleProcessor(BackgroundTaskComponent):
                             snap = await snap  # consumer on a wire bus
                         ckpt = (sink.dispatch_count, snap)
         finally:
+            if deferred_consumer is not None:
+                deferred_consumer.close()
             consumer.close()
 
 

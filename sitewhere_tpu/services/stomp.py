@@ -191,10 +191,22 @@ class StompListener:
                 command, headers, body = frame
                 if command == "SEND":
                     dest = headers.get("destination", "")
+                    accepted = True
                     try:
-                        await self.on_message(dest, body, user or "stomp")
+                        accepted = await self.on_message(dest, body,
+                                                         user or "stomp")
                     except Exception:
                         logger.exception("stomp: on_message failed")
+                    if accepted is False:
+                        # over-quota flow control: ERROR + close is the
+                        # STOMP-appropriate refusal (§ERROR: the server
+                        # MUST close the connection after an ERROR frame)
+                        err = {"message": "over quota: publish rejected"}
+                        rid = headers.get("receipt")
+                        if rid is not None:
+                            err["receipt-id"] = rid
+                        await self._send(writer, "ERROR", err)
+                        return
                     await self._receipt(writer, headers)
                 elif command in ("SUBSCRIBE", "UNSUBSCRIBE", "ACK", "NACK",
                                  "BEGIN", "COMMIT", "ABORT"):

@@ -78,6 +78,8 @@ class WebSocketListener:
         # protocol-violation drops (hostile/broken peers) — the fuzz
         # suite's observability hook, mirrors CoapListener.malformed
         self.malformed = 0
+        # messages refused by the ingest hook (over-quota flow control)
+        self.rejected = 0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host,
@@ -240,7 +242,16 @@ class WebSocketListener:
                 if fin:
                     message = bytes(buffer)
                     buffer.clear()
-                    await self.on_message(message, client_id)
+                    accepted = await self.on_message(message, client_id)
+                    if accepted is False:
+                        # over-quota flow control: close 1013 "try again
+                        # later" (RFC 6455 §7.4.1), the WebSocket-
+                        # appropriate overload signal
+                        self.rejected += 1
+                        writer.write(_frame(OP_CLOSE,
+                                            (1013).to_bytes(2, "big")))
+                        await writer.drain()
+                        return
         except ValueError as exc:
             self.malformed += 1
             logger.info("ws: protocol violation, dropping %s: %s",

@@ -1,0 +1,460 @@
+"""Flow-control subsystem tests (kernel/flow.py, ISSUE 2).
+
+Token-bucket conformance under a fake clock, DRR fairness, shed-policy
+transitions, REST 429 + Retry-After, Kafka Produce throttle-time, the
+shed routing inside rule-processing, and DLQ replay passing through
+flow control like live traffic.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+from sitewhere_tpu.kernel.bus import EventBus, TopicNaming
+from sitewhere_tpu.kernel.flow import (
+    DegradedZscore,
+    DrrScheduler,
+    FlowController,
+    OverloadController,
+    TokenBucket,
+)
+
+from tests.test_pipeline import running_pipeline, wait_until
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- token bucket ------------------------------------------------------------
+
+def test_token_bucket_rate_and_burst():
+    clock = FakeClock()
+    b = TokenBucket(rate=100.0, burst=10.0, clock=clock)
+    # burst: 10 immediate acquisitions, the 11th is refused
+    for _ in range(10):
+        assert b.try_acquire(1)
+    assert not b.try_acquire(1)
+    # retry_after names the exact refill horizon for 1 token at 100/s
+    assert abs(b.retry_after(1) - 0.01) < 1e-9
+    # refill is rate-proportional...
+    clock.advance(0.05)
+    for _ in range(5):
+        assert b.try_acquire(1)
+    assert not b.try_acquire(1)
+    # ...and capped at burst after a long idle
+    clock.advance(100.0)
+    assert b.tokens == 10.0
+    assert b.try_acquire(10) and not b.try_acquire(1)
+
+
+def test_token_bucket_bulk_and_conformance():
+    """Sustained draw at exactly the configured rate always admits;
+    rate + epsilon eventually refuses — the ±burst conformance bound."""
+    clock = FakeClock()
+    b = TokenBucket(rate=1000.0, burst=50.0, clock=clock)
+    admitted = 0
+    for _ in range(200):           # offer 2000 ev/s for 1 s in 5 ms steps
+        clock.advance(0.005)
+        if b.try_acquire(10):
+            admitted += 10
+    # admitted ≤ rate × horizon + burst, and ≥ rate × horizon − one draw
+    assert 990 <= admitted <= 1050
+
+
+# -- deficit round robin -----------------------------------------------------
+
+def test_drr_equal_weights_10_to_1_offered_load():
+    s = DrrScheduler(quantum=1.0)
+    for i in range(2000):
+        s.enqueue("hog", ("hog", i))
+    for i in range(200):
+        s.enqueue("meek", ("meek", i))
+    drained = s.drain(max_entries=400)
+    shares = {"hog": 0, "meek": 0}
+    for lane, _payload, _cost in drained:
+        shares[lane] += 1
+    # equal weights → equal drained shares despite 10:1 offered load
+    assert abs(shares["hog"] - shares["meek"]) <= 0.1 * 400
+
+
+def test_drr_weighted_shares():
+    s = DrrScheduler(quantum=1.0)
+    s.lane_weight("big", 3.0)
+    s.lane_weight("small", 1.0)
+    for i in range(1000):
+        s.enqueue("big", i)
+        s.enqueue("small", i)
+    drained = s.drain(max_entries=400)
+    big = sum(1 for lane, *_ in drained if lane == "big")
+    assert abs(big / 400 - 0.75) <= 0.1
+
+
+def test_drr_drains_everything():
+    s = DrrScheduler()
+    s.enqueue("a", 1, cost=5.0)      # cost above quantum: needs passes
+    s.enqueue("b", 2)
+    assert {p for _, p, _ in s.drain()} == {1, 2}
+    assert s.pending == 0 and s.take() is None
+
+
+# -- shed-policy state machine ----------------------------------------------
+
+def test_shed_policy_transitions_reject_degrade_defer():
+    c = OverloadController(reject_at=0.5, degrade_at=0.75, defer_at=0.9,
+                           hysteresis=0.8)
+    assert c.mode == "ok"
+    assert c.update(0.3) == "ok"
+    assert c.update(0.55) == "reject"
+    assert c.update(0.8) == "degrade"
+    assert c.update(0.95) == "defer"
+    # hysteresis: 0.85 ≥ 0.9 × 0.8 → still defer (no flap at the edge)
+    assert c.update(0.85) == "defer"
+    # below 0.72 → de-escalates to whatever the pressure names (reject)
+    assert c.update(0.6) == "reject"
+    # below 0.5 × 0.8 → fully recovered
+    assert c.update(0.3) == "ok"
+
+
+def test_flow_controller_overload_gates_ingress():
+    fc = FlowController(InstanceSettings(), clock=FakeClock())
+    fc.set_quota("t", rate=1000.0, burst=100.0)
+    assert fc.admit_ingress("t", 10).admitted
+    fc.force_mode("t", "reject")
+    d = fc.admit_ingress("t", 10)
+    assert not d.admitted and d.reason == "overload:reject"
+    fc.force_mode("t", "ok")
+    assert fc.admit_ingress("t", 10).admitted
+
+
+def test_report_scorer_drives_mode():
+    fc = FlowController(InstanceSettings(), clock=FakeClock())
+    fc.set_quota("t", rate=0.0)
+    assert fc.report_scorer("t", pending=100, cap=1000) == "ok"
+    assert fc.report_scorer("t", pending=800, cap=1000) == "degrade"
+    assert fc.report_scorer("t", pending=980, cap=1000) == "defer"
+    assert fc.report_scorer("t", pending=0, cap=1000) == "ok"
+
+
+# -- degraded fallback scorer ------------------------------------------------
+
+def test_degraded_zscore_flags_spikes():
+    dz = DegradedZscore()
+    dev = np.arange(64, dtype=np.uint32)
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        dz.score(dev, rng.normal(20.0, 0.5, 64).astype(np.float32))
+    vals = rng.normal(20.0, 0.5, 64).astype(np.float32)
+    vals[7] = 60.0
+    z = dz.score(dev, vals)
+    assert z[7] > 10.0
+    assert np.median(z[np.arange(64) != 7]) < 3.0
+
+
+# -- weighted-fair inbound admission ----------------------------------------
+
+def test_admit_fair_uncapped_is_passthrough(run):
+    async def main():
+        fc = FlowController(InstanceSettings())   # flow_inbound_rate = 0
+        await asyncio.wait_for(fc.admit_fair("t", 1000.0), 1.0)
+
+    run(main())
+
+
+def test_admit_fair_capped_grants_all(run):
+    async def main():
+        # offered (120 × 2048) exceeds burst (2 × rate): the tail queues
+        # in DRR lanes and every waiter must still be granted (liveness
+        # under contention; fairness itself is pinned by the DRR tests)
+        fc = FlowController(InstanceSettings(flow_inbound_rate=100_000.0))
+        waits = [fc.admit_fair(tid, 2048.0)
+                 for tid in ("a", "b") for _ in range(60)]
+        await asyncio.wait_for(asyncio.gather(*waits), 15.0)
+
+    run(main())
+
+
+# -- rule-processing shed routing (end-to-end) -------------------------------
+
+def _enriched_batch(n=32, t=5000.0):
+    return MeasurementBatch(
+        BatchContext(tenant_id="acme", source="test"),
+        np.arange(n, dtype=np.uint32), np.zeros(n, np.uint16),
+        np.full(n, 21.0, np.float32), np.full(n, t))
+
+
+_RULE_SECTIONS = {"rule-processing": {
+    "model": "zscore", "model_config": {"window": 16},
+    "threshold": 6.0, "batch_window_ms": 1.0, "buckets": [256]}}
+
+
+def test_defer_mode_spools_then_replays(run):
+    async def main():
+        async with running_pipeline(num_devices=32,
+                                    sections=_RULE_SECTIONS) as rt:
+            session = rt.api("rule-processing").engine("acme").session
+            await wait_until(lambda: session.ready)
+            enriched = rt.naming.tenant_topic(
+                "acme", TopicNaming.OUTBOUND_ENRICHED)
+            deferred = rt.naming.tenant_topic(
+                "acme", TopicNaming.DEFERRED_EVENTS)
+            # overload ingress gate: any shed mode rejects new publishes
+            receiver = rt.api("event-sources").engine("acme") \
+                .receiver("default")
+            from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+            sim = DeviceSimulator(SimConfig(num_devices=32),
+                                  tenant_id="acme")
+            rt.flow.force_mode("acme", "defer")
+            assert not await receiver.submit(sim.payload(t=100.0)[0])
+            # traffic already inside the pipeline is spooled, not scored:
+            # feed the scorer's consumer directly while defer is pinned
+            for k in range(2):
+                await rt.bus.produce(enriched,
+                                     _enriched_batch(t=5000.0 + k))
+            await wait_until(
+                lambda: sum(len(r.value) for r in rt.bus.peek(
+                    deferred, limit=100)) >= 64)
+            snap = rt.metrics.snapshot()
+            assert snap.get("flow.shed_defer:acme", 0) >= 64
+            assert session.scored_meter.rate(60.0) == 0.0  # nothing scored
+            # overload clears → the spool drains back through the scorer
+            rt.flow.force_mode("acme", "ok")
+            await wait_until(
+                lambda: rt.metrics.snapshot().get(
+                    "flow.deferred_replayed:acme", 0) >= 64, timeout=15.0)
+            await wait_until(lambda: session.latency.count >= 64,
+                             timeout=15.0)
+
+    run(main())
+
+
+def test_degrade_mode_scores_via_fallback(run):
+    async def main():
+        async with running_pipeline(num_devices=32,
+                                    sections=_RULE_SECTIONS) as rt:
+            session = rt.api("rule-processing").engine("acme").session
+            await wait_until(lambda: session.ready)
+            enriched = rt.naming.tenant_topic(
+                "acme", TopicNaming.OUTBOUND_ENRICHED)
+            scored_topic = rt.naming.tenant_topic(
+                "acme", TopicNaming.SCORED_EVENTS)
+            consumer = rt.bus.subscribe(scored_topic, group="t.flowdeg")
+            rt.flow.force_mode("acme", "degrade")
+            await rt.bus.produce(enriched, _enriched_batch())
+            scored = []
+
+            def got_fallback():
+                scored.extend(r.value
+                              for r in consumer.poll_nowait(max_records=64))
+                # model_version -1 marks the degraded fallback scorer
+                return any(b.model_version == -1 for b in scored)
+
+            await wait_until(got_fallback)
+            snap = rt.metrics.snapshot()
+            assert snap.get("flow.shed_degrade:acme", 0) >= 32
+            consumer.close()
+
+    run(main())
+
+
+# -- REST: 429 + Retry-After -------------------------------------------------
+
+def test_rest_ingest_429_retry_after(run):
+    from tests.test_rest import http, rest_instance
+
+    async def main():
+        async with rest_instance() as (rt, port):
+            _, body = await http(port, "POST", "/api/jwt",
+                                 basic="admin:password")
+            token = body["token"]
+            await http(port, "POST", "/api/tenants", token=token,
+                       body={"token": "acme", "sections": {
+                           "flow": {"rate": 0.1, "burst": 2.0}}})
+            await http(port, "POST", "/api/devicetypes", token=token,
+                       tenant="acme", body={"token": "dt", "name": "T"})
+            await http(port, "POST", "/api/devices", token=token,
+                       tenant="acme", body={"token": "d1",
+                                            "deviceType": "dt"})
+            # burst 2 admits two, the third answers 429 + Retry-After
+            statuses = []
+            for _ in range(3):
+                status, headers, data = await http(
+                    port, "POST", "/api/assignments/d1-a/measurements",
+                    token=token, tenant="acme",
+                    body={"mtype": 0, "value": 1.0}, raw=True)
+                statuses.append((status, headers))
+            assert [s for s, _ in statuses[:2]] == [200, 200]
+            status, headers = statuses[2]
+            assert status == 429
+            assert int(headers["retry-after"]) >= 1
+            # quota surface reflects the live state
+            status, body = await http(port, "GET",
+                                      "/api/tenants/acme/quota",
+                                      token=token)
+            assert status == 200 and body["rate"] == 0.1
+            assert body["rejected"] >= 1
+            # runtime update opens the gate without an engine respin
+            status, body = await http(port, "PUT",
+                                      "/api/tenants/acme/quota",
+                                      token=token, body={"rate": 10000.0})
+            assert status == 200 and body["rate"] == 10000.0
+            status, _ = await http(
+                port, "POST", "/api/assignments/d1-a/measurements",
+                token=token, tenant="acme", body={"mtype": 0, "value": 1.0})
+            assert status == 200
+
+    run(main())
+
+
+# -- Kafka: Produce v1 throttle-time ----------------------------------------
+
+def _s(v):
+    b = v.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+async def _kafka_produce_v1(host, port, topic, n_msgs):
+    """Minimal Produce v1 (body identical to v0; response appends
+    throttle_time_ms). Returns (error_code, base_offset, throttle_ms)."""
+    from sitewhere_tpu.kernel.kafka_endpoint import encode_message_set
+
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        mset = encode_message_set(
+            [(i, None, b"x" * 8, 0) for i in range(n_msgs)])
+        body = (struct.pack(">hi", 1, 1000)        # acks=1, timeout
+                + struct.pack(">i", 1) + _s(topic)
+                + struct.pack(">i", 1) + struct.pack(">i", 0)
+                + struct.pack(">i", len(mset)) + mset)
+        req = struct.pack(">hhi", 0, 1, 77) + _s("flow-test") + body
+        writer.write(struct.pack(">i", len(req)) + req)
+        await writer.drain()
+        size = struct.unpack(">i", await reader.readexactly(4))[0]
+        payload = memoryview(await reader.readexactly(size))
+        corr = struct.unpack_from(">i", payload, 0)[0]
+        assert corr == 77
+        off = 4
+        n_topics = struct.unpack_from(">i", payload, off)[0]
+        off += 4
+        assert n_topics == 1
+        name_len = struct.unpack_from(">h", payload, off)[0]
+        off += 2 + name_len
+        n_parts = struct.unpack_from(">i", payload, off)[0]
+        off += 4
+        assert n_parts == 1
+        _pid, err, base = struct.unpack_from(">ihq", payload, off)
+        off += 14
+        throttle_ms = struct.unpack_from(">i", payload, off)[0]
+        return err, base, throttle_ms
+    finally:
+        writer.close()
+
+
+def test_kafka_produce_v1_throttle_time(run):
+    from sitewhere_tpu.kernel.kafka_endpoint import KafkaEndpoint
+
+    async def main():
+        bus = EventBus(default_partitions=1)
+        naming = TopicNaming("flowk")
+        fc = FlowController(InstanceSettings())
+        fc.set_quota("t1", rate=10.0, burst=5.0)
+        ep = KafkaEndpoint(bus, flow=fc, naming=naming)
+        await ep.start()
+        try:
+            topic = naming.tenant_topic("t1", "event-source-decoded-events")
+            # within burst: no throttle
+            err, base, throttle = await _kafka_produce_v1(
+                "127.0.0.1", ep.port, topic, 3)
+            assert err == 0 and throttle == 0
+            # over quota: records still accepted (Kafka quota semantics)
+            # but the response carries a positive throttle hint
+            err, base2, throttle = await _kafka_produce_v1(
+                "127.0.0.1", ep.port, topic, 40)
+            assert err == 0 and throttle > 0
+            assert bus._topics[topic].partitions[0].end_offset == 43
+            # a non-tenant topic is never throttled
+            err, _, throttle = await _kafka_produce_v1(
+                "127.0.0.1", ep.port, "plain-topic", 40)
+            assert err == 0 and throttle == 0
+        finally:
+            await ep.stop()
+
+    run(main())
+
+
+# -- DLQ replay passes through flow control ----------------------------------
+
+def _mk_batch(n=1):
+    return MeasurementBatch(
+        BatchContext(tenant_id="t", source="test"),
+        np.arange(n, dtype=np.uint32), np.zeros(n, np.uint16),
+        np.ones(n, np.float32), np.full(n, 1000.0))
+
+
+def test_dlq_replay_respects_quota(run):
+    from sitewhere_tpu.kernel.dlq import quarantine, replay_dead_letters
+
+    async def main():
+        bus = EventBus(default_partitions=1)
+        clock = FakeClock()
+        fc = FlowController(InstanceSettings(), clock=clock)
+        fc.set_quota("t", rate=1.0, burst=2.0)
+        src_topic, dlq_topic = "src", "t.dlq"
+        for _ in range(5):
+            await bus.produce(src_topic, _mk_batch(1))
+        consumer = bus.subscribe(src_topic, group="g")
+        for rec in await consumer.poll(max_records=5, timeout=0.5):
+            await quarantine(bus, dlq_topic, rec,
+                             ValueError("poison"), "test")
+        consumer.commit()
+        # burst 2 → replay admits exactly 2, then pauses over quota
+        n = await replay_dead_letters(bus, dlq_topic, flow=fc, tenant_id="t")
+        assert n == 2
+        # nothing refilled: a second call replays nothing more
+        assert await replay_dead_letters(bus, dlq_topic, flow=fc,
+                                         tenant_id="t") == 0
+        # quota refills → the SAME records resume (no duplicates, no loss)
+        clock.advance(10.0)
+        assert await replay_dead_letters(bus, dlq_topic, flow=fc,
+                                         tenant_id="t") == 2
+        clock.advance(10.0)
+        assert await replay_dead_letters(bus, dlq_topic, flow=fc,
+                                         tenant_id="t") == 1
+        end = bus._topics[src_topic].partitions[0].end_offset
+        assert end == 10    # 5 originals + 5 replayed exactly once
+
+    run(main())
+
+
+# -- chaos seams -------------------------------------------------------------
+
+def test_flow_fault_sites_armed():
+    from sitewhere_tpu.kernel.faults import FaultInjected, FaultInjector
+
+    fc = FlowController(InstanceSettings())
+    fc.faults = FaultInjector(seed=1).arm("flow.admit", rate=1.0,
+                                          max_faults=1)
+    try:
+        fc.admit_ingress("t", 1)
+        raise AssertionError("flow.admit fault did not fire")
+    except FaultInjected:
+        pass
+    assert fc.admit_ingress("t", 1).admitted   # bounded: next call is clean
+    fc.faults.arm("flow.shed", rate=1.0, max_faults=1)
+    try:
+        fc.shed_mode("t")
+        raise AssertionError("flow.shed fault did not fire")
+    except FaultInjected:
+        pass
+    assert fc.shed_mode("t") == "ok"

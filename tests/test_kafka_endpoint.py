@@ -165,7 +165,9 @@ def test_round_trips_and_offsets(run):
         bus, ep, client = await _setup()
         try:
             versions = await client.api_versions()
-            assert (0, 0, 0) in versions        # Produce v0 served
+            # Produce v0..v1 served (v1 adds throttle_time_ms — the
+            # flow-control quota surface; see tests/test_flow.py)
+            assert (0, 0, 1) in versions
 
             # in-proc object -> Kafka fetch (codec bytes decode back)
             batch = _mk_batch()

@@ -1,0 +1,82 @@
+"""Metrics registry unit tests (ISSUE 2 satellite): histogram quantile
+edges (empty histogram must return 0, never raise) and the Prometheus
+exposition round-trip."""
+
+import math
+
+from sitewhere_tpu.kernel.metrics import Histogram, MetricsRegistry
+
+
+def test_histogram_quantile_empty_never_raises():
+    h = Histogram("t")
+    for q in (-1.0, 0.0, 0.5, 0.99, 1.0, 2.0, float("nan")):
+        v = h.quantile(q)
+        assert v == 0.0 and not math.isnan(v)
+    assert h.mean == 0.0
+    # reset keeps the guarantee
+    h.observe(1.0)
+    h.reset()
+    assert h.quantile(0.99) == 0.0
+
+
+def test_histogram_quantile_edges_and_clamp():
+    h = Histogram("t")
+    for v in (0.001, 0.002, 0.004, 0.008, 0.016):
+        h.observe(v)
+    # q is clamped into [0, 1]; out-of-range asks never raise
+    assert h.quantile(-0.5) <= h.quantile(0.5) <= h.quantile(1.0)
+    assert h.quantile(2.0) == h.quantile(1.0)
+    # p100 is bounded by the observed max (not a bucket upper edge)
+    assert h.quantile(1.0) <= 0.016 + 1e-12
+    # q=0 reads from the lowest occupied bucket, not an upper bound
+    assert h.quantile(0.0) <= h.quantile(0.5)
+    # single sample: every quantile is that bucket's estimate
+    h1 = Histogram("one")
+    h1.observe(0.005)
+    assert 0.0 < h1.quantile(0.5) <= 0.005 + 1e-12
+    assert h1.quantile(0.99) == h1.quantile(0.01)
+
+
+def test_histogram_overflow_bucket():
+    h = Histogram("t", buckets=[0.1, 1.0])
+    h.observe(50.0)      # beyond the last bucket edge
+    assert h.quantile(0.99) == 50.0
+    assert h.count == 1
+
+
+def test_export_prometheus_text_round_trip():
+    reg = MetricsRegistry(namespace="swx")
+    reg.counter("flow.admitted").inc(42)
+    reg.gauge("flow.pressure:t1").set(0.25)
+    h = reg.histogram("scoring.e2e_latency_s")
+    for v in (0.001, 0.004, 0.02):
+        h.observe(v)
+    text = reg.prometheus_text()
+    # parse the exposition back and compare against the live registry
+    values = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        values[name] = float(val)
+    assert values["swx_flow_admitted"] == 42.0
+    assert values["swx_flow_pressure:t1"] == 0.25
+    assert values["swx_scoring_e2e_latency_s_count"] == 3.0
+    assert abs(values["swx_scoring_e2e_latency_s_sum"] - 0.025) < 1e-12
+    assert (values['swx_scoring_e2e_latency_s{quantile="0.5"}']
+            == h.quantile(0.5))
+    assert (values['swx_scoring_e2e_latency_s{quantile="0.99"}']
+            == h.quantile(0.99))
+    # metric names are sanitized to the prometheus charset
+    for name in values:
+        base = name.split("{")[0]
+        assert all(c.isalnum() or c in "_:" for c in base), name
+
+
+def test_snapshot_includes_p95():
+    reg = MetricsRegistry()
+    h = reg.histogram("h")
+    for i in range(100):
+        h.observe(0.001 * (i + 1))
+    snap = reg.snapshot()["h"]
+    assert snap["p50"] <= snap["p95"] <= snap["p99"] <= snap["max"]
