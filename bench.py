@@ -162,6 +162,22 @@ def probe_backend_supervised(horizon_s: float = 600.0,
         f"{attempt} subprocess probes (tunnel down?): {last_err}")
 
 
+def _lint_summary():
+    """Static-analysis health stamped into every artifact: new/baselined
+    swxlint finding counts (sitewhere_tpu/analysis). A rising `new`
+    count across rounds is a contract regression the trajectory should
+    show, exactly like a throughput drop. Never fails the bench."""
+    try:
+        from sitewhere_tpu.analysis import lint_package
+
+        report = lint_package()
+        return {"new": len(report.findings),
+                "baselined": len(report.baselined),
+                "suppressed": len(report.suppressed)}
+    except Exception as exc:  # noqa: BLE001 - the artifact must still parse
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def _error_artifact(args, msg: str) -> str:
     return json.dumps({
         "metric": ("train_windows_per_sec" if args.train
@@ -898,6 +914,7 @@ async def run_overload_bench(args) -> dict:
         "model": "zscore",
         "seconds": round(cont_elapsed, 2),
         "platform": platform, "device_kind": device_kind, "chips": n_chips,
+        "lint": _lint_summary(),
     }
 
 
@@ -1245,6 +1262,7 @@ async def run_bench(args) -> dict:
         "durable": bool(args.durable),
         "durable_spill": spill,
         "chaos": chaos,
+        "lint": _lint_summary(),
         "chips": n_chips,
         "device_kind": device_kind,
         "platform": platform,

@@ -1,13 +1,19 @@
 #!/usr/bin/env bash
 # The tier-1 verification gate, runnable identically by builders and
-# reviewers. Two steps:
+# reviewers. Three steps:
 #   1. a compileall syntax smoke over the package (fails fast on a file
 #      that only breaks at import time), then
-#   2. the ROADMAP.md "Tier-1 verify" command VERBATIM — keep the block
+#   2. `swx lint` (the AST invariant checker, docs/ANALYSIS.md) — new
+#      findings fail the gate before a single test runs, then
+#   3. the ROADMAP.md "Tier-1 verify" command VERBATIM — keep the block
 #      below byte-identical to ROADMAP.md so both audiences run the same
 #      gate.
 cd "$(dirname "$0")/.."
 
 python -m compileall -q sitewhere_tpu || exit 1
+
+# `swx lint --format json` without the CLI entrypoint dependency; the
+# JSON report is the CI artifact (exit 1 = new findings, see output)
+python -m sitewhere_tpu.analysis --format json || { echo "swxlint: new findings (see JSON above; docs/ANALYSIS.md)"; exit 1; }
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

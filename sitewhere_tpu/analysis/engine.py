@@ -1,0 +1,354 @@
+"""swxlint engine: one AST walk, shared across every checker.
+
+The engine parses every module under the package root exactly once
+(`Module` wraps source + AST + suppression pragmas + scope index), builds
+a project-wide class-hierarchy index (`Project` — LIF01 needs transitive
+subclass facts across files), then runs each checker over each module.
+
+Findings are classified three ways:
+
+- *suppressed*: the finding's line carries `# swxlint: disable=CODE`
+  (comma list; `ALL` matches every code), or the module carries
+  `# swxlint: disable-file=CODE`. Suppression is same-line — put the
+  pragma on the reported line, with a short justification after it.
+- *baselined*: the finding matches an entry in the baseline file
+  (`scripts/swxlint-baseline.json`) by (path, code, qualname). Baseline
+  entries MUST carry a non-empty `reason` — an undocumented entry is
+  ignored and the finding fails, which is what keeps the baseline a
+  list of *documented* false positives rather than a mute button.
+- *new*: everything else. New findings fail the build (exit 1).
+
+Line numbers are deliberately NOT part of the baseline fingerprint:
+unrelated edits above a grandfathered finding must not resurrect it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+_PRAGMA = re.compile(r"#\s*swxlint:\s*disable=([A-Z0-9_,\s]+)")
+_FILE_PRAGMA = re.compile(r"#\s*swxlint:\s*disable-file=([A-Z0-9_,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # package-relative posix path
+    line: int
+    code: str          # stable check code, e.g. "DLQ01"
+    message: str
+    hint: str = ""     # one-line fix hint
+    qualname: str = "" # enclosing Class.method scope (baseline fingerprint)
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: {self.code} {self.message}"
+        if self.hint:
+            out += f"  [fix: {self.hint}]"
+        return out
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.code, self.qualname)
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "line": self.line, "code": self.code,
+                "message": self.message, "hint": self.hint,
+                "qualname": self.qualname}
+
+
+class Module:
+    """One parsed source file: AST + pragmas + scope index, parsed once."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.file_disables: set[str] = set()
+        self.line_disables: dict[int, set[str]] = {}
+        for i, text in enumerate(self.lines, 1):
+            m = _PRAGMA.search(text)
+            if m:
+                self.line_disables[i] = _codes(m.group(1))
+            m = _FILE_PRAGMA.search(text)
+            if m:
+                self.file_disables |= _codes(m.group(1))
+        # (start_line, end_line, qualname) per def/class, innermost last
+        self._scopes: list[tuple[int, int, str]] = []
+        self._index_scopes(self.tree, ())
+
+    def _index_scopes(self, node: ast.AST, stack: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = (*stack, child.name)
+                self._scopes.append((child.lineno,
+                                     child.end_lineno or child.lineno,
+                                     ".".join(qual)))
+                self._index_scopes(child, qual)
+            else:
+                self._index_scopes(child, stack)
+
+    def qualname_at(self, line: int) -> str:
+        """Innermost def/class scope covering `line` ("" at module level)."""
+        best = ""
+        best_span = None
+        for start, end, qual in self._scopes:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.code in self.file_disables or "ALL" in self.file_disables:
+            return True
+        codes = self.line_disables.get(finding.line, ())
+        return finding.code in codes or "ALL" in codes
+
+
+def _codes(raw: str) -> set[str]:
+    return {c.strip() for c in raw.split(",") if c.strip()}
+
+
+class Project:
+    """Cross-module facts the per-module checkers share."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        # class name -> base names (name-based; fine for one package)
+        self.class_bases: dict[str, set[str]] = {}
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    bases = set()
+                    for b in node.bases:
+                        if isinstance(b, ast.Name):
+                            bases.add(b.id)
+                        elif isinstance(b, ast.Attribute):
+                            bases.add(b.attr)
+                    self.class_bases.setdefault(node.name, set()).update(bases)
+
+    def is_subclass_of(self, name: str, root: str, *,
+                       strict: bool = True) -> bool:
+        """Transitive name-based subclass check. With `strict`, the root
+        itself does not count (the defining class is exempt from rules
+        about overriding its own methods)."""
+        if name == root:
+            return not strict
+        seen: set[str] = set()
+        frontier = [name]
+        while frontier:
+            cur = frontier.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for base in self.class_bases.get(cur, ()):
+                if base == root:
+                    return True
+                frontier.append(base)
+        return False
+
+
+Checker = Callable[[Module, Project], Iterable[Finding]]
+
+
+def default_checkers() -> list[Checker]:
+    from sitewhere_tpu.analysis.checkers_async import check_async_blocking
+    from sitewhere_tpu.analysis.checkers_flow import (
+        check_dlq_quarantine,
+        check_flow_consult,
+    )
+    from sitewhere_tpu.analysis.checkers_lifecycle import check_lifecycle_super
+    from sitewhere_tpu.analysis.checkers_registry import (
+        check_fault_sites,
+        check_metric_names,
+    )
+
+    return [check_async_blocking, check_flow_consult, check_dlq_quarantine,
+            check_fault_sites, check_metric_names, check_lifecycle_super]
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+@dataclass
+class Baseline:
+    """Grandfathered findings: (path, code, qualname) -> reason."""
+
+    entries: dict[tuple[str, str, str], str] = field(default_factory=dict)
+    undocumented: list[dict] = field(default_factory=list)
+
+    @staticmethod
+    def load(path: Optional[Path]) -> "Baseline":
+        bl = Baseline()
+        if path is None or not path.exists():
+            return bl
+        doc = json.loads(path.read_text())
+        for entry in doc.get("entries", []):
+            key = (entry.get("path", ""), entry.get("code", ""),
+                   entry.get("qualname", ""))
+            reason = (entry.get("reason") or "").strip()
+            if reason:
+                bl.entries[key] = reason
+            else:
+                # an entry with no reason is not a baseline, it's a mute
+                # button — ignore it so the finding still fails
+                bl.undocumented.append(entry)
+        return bl
+
+    def match(self, finding: Finding) -> Optional[str]:
+        return self.entries.get(finding.key)
+
+    @staticmethod
+    def dump(findings: list[Finding], path: Path) -> None:
+        entries = [{"path": f.path, "code": f.code, "qualname": f.qualname,
+                    "reason": ""} for f in findings]
+        path.write_text(json.dumps({
+            "_comment": "swxlint baseline: grandfathered findings. Every "
+                        "entry MUST say in `reason` why it is a false "
+                        "positive — entries without a reason are ignored "
+                        "and the finding fails.",
+            "entries": entries,
+        }, indent=2) + "\n")
+
+
+# -- report ------------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: list[Finding]           # new (failing)
+    baselined: list[tuple[Finding, str]]
+    suppressed: list[Finding]
+    stale_baseline: list[dict]        # entries matching nothing anymore
+    undocumented_baseline: list[dict]
+    checked_files: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "clean": not self.findings,
+            "checked_files": self.checked_files,
+            "counts": self.counts(),
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [{**f.to_json(), "reason": r}
+                          for f, r in self.baselined],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "stale_baseline": self.stale_baseline,
+            "undocumented_baseline": self.undocumented_baseline,
+        }
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in self.findings]
+        if self.stale_baseline:
+            lines.append(f"note: {len(self.stale_baseline)} stale baseline "
+                         f"entr{'y' if len(self.stale_baseline) == 1 else 'ies'}"
+                         f" no longer match anything — prune them:")
+            lines += [f"  - {e.get('path')}::{e.get('qualname')} "
+                      f"[{e.get('code')}]" for e in self.stale_baseline]
+        if self.undocumented_baseline:
+            lines.append(f"note: {len(self.undocumented_baseline)} baseline "
+                         f"entries have no `reason` and were IGNORED")
+        lines.append(
+            f"swxlint: {len(self.findings)} new finding(s), "
+            f"{len(self.baselined)} baselined, "
+            f"{len(self.suppressed)} suppressed "
+            f"across {self.checked_files} files")
+        return "\n".join(lines)
+
+
+# -- engine ------------------------------------------------------------------
+
+
+class LintEngine:
+    def __init__(self, modules: list[Module],
+                 baseline: Optional[Baseline] = None,
+                 checkers: Optional[list[Checker]] = None):
+        self.modules = modules
+        self.baseline = baseline or Baseline()
+        self.checkers = checkers if checkers is not None else default_checkers()
+
+    def run(self) -> Report:
+        project = Project(self.modules)
+        new: list[Finding] = []
+        baselined: list[tuple[Finding, str]] = []
+        suppressed: list[Finding] = []
+        matched_keys: set[tuple[str, str, str]] = set()
+        for mod in self.modules:
+            for checker in self.checkers:
+                for finding in checker(mod, project):
+                    if mod.suppressed(finding):
+                        suppressed.append(finding)
+                        continue
+                    reason = self.baseline.match(finding)
+                    if reason is not None:
+                        baselined.append((finding, reason))
+                        matched_keys.add(finding.key)
+                        continue
+                    new.append(finding)
+        stale = [{"path": p, "code": c, "qualname": q, "reason": r}
+                 for (p, c, q), r in self.baseline.entries.items()
+                 if (p, c, q) not in matched_keys]
+        new.sort(key=lambda f: (f.path, f.line, f.code))
+        return Report(findings=new, baselined=baselined,
+                      suppressed=suppressed, stale_baseline=stale,
+                      undocumented_baseline=self.baseline.undocumented,
+                      checked_files=len(self.modules))
+
+
+def _walk_package(root: Path) -> list[Module]:
+    base = root.parent
+    modules = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(base).as_posix()
+        modules.append(Module(rel, path.read_text()))
+    return modules
+
+
+def package_root() -> Path:
+    import sitewhere_tpu
+
+    return Path(sitewhere_tpu.__file__).resolve().parent
+
+
+def default_baseline_path(root: Optional[Path] = None) -> Path:
+    root = root or package_root()
+    return root.parent / "scripts" / "swxlint-baseline.json"
+
+
+def lint_package(root: Optional[Path] = None,
+                 baseline_path: Optional[Path] = None,
+                 checkers: Optional[list[Checker]] = None) -> Report:
+    """Lint the installed package (or `root`) against its baseline —
+    the one-call entry bench.py and the meta-test use."""
+    root = Path(root) if root else package_root()
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    engine = LintEngine(_walk_package(root),
+                        baseline=Baseline.load(Path(baseline_path)),
+                        checkers=checkers)
+    return engine.run()
+
+
+def lint_sources(sources: dict[str, str],
+                 baseline: Optional[Baseline] = None,
+                 checkers: Optional[list[Checker]] = None) -> Report:
+    """Lint in-memory sources ({relpath: source}) — the fixture-test entry."""
+    modules = [Module(rel, src) for rel, src in sorted(sources.items())]
+    return LintEngine(modules, baseline=baseline, checkers=checkers).run()

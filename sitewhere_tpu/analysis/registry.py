@@ -1,0 +1,127 @@
+"""Central registry of fault sites and metric names.
+
+Fault sites and metric names ride the codebase as bare string literals
+(the platform contract: a site is greppable, a metric name is the
+dashboard's key). A typo — `"flow.admitt"`, a counter name registered
+elsewhere as a gauge — used to fail only at dashboard-reading time.
+This module is the single source of truth the static checkers (FLT01 /
+MET01, `swx lint`) resolve every literal against, and the runtime
+cross-check `FaultInjector.arm` consults in debug mode.
+
+Generated from the current sites (regenerate the raw inventory with
+`python -m sitewhere_tpu.analysis --dump-registry` after adding a site
+or metric, then fold the new names in here — the diff IS the review).
+
+Adding a fault site: add the literal to `FAULT_SITES`, then consult it
+via `faults.check(site)` / `await faults.acheck(site)`.
+Adding a metric: add the base name (the part before any `:tenant`
+suffix) under its kind below. A name may have exactly ONE kind — the
+import-time check at the bottom fails the build on a conflict.
+"""
+
+from __future__ import annotations
+
+# -- fault-injection sites (kernel/faults.py consults) ----------------------
+
+FAULT_SITES = frozenset({
+    "bus.produce",        # kernel/bus.py EventBus.produce
+    "bus.poll",           # kernel/bus.py Consumer.poll_nowait
+    "inbound.handle",     # services/inbound_processing.py per-record handle
+    "durable.flush",      # persistence/durable.py spill writer
+    "scoring.dispatch",   # scoring/server.py flush paths
+    "flow.admit",         # kernel/flow.py ingress admission
+    "flow.shed",          # kernel/flow.py shed-mode consult
+})
+
+# -- metric base names, by kind (kernel/metrics.py registry) ----------------
+# Per-tenant variants use the `:{tenant_id}` suffix on the same base name
+# and share the base's registration.
+
+COUNTERS = (
+    # scoring plane
+    "scoring.anomalies_detected",
+    "scoring.anomaly_overflow",
+    "scoring.pool_flush_rounds",
+    "scoring.admissions_dropped",
+    "scoring.sink_failures",
+    "scoring.bus_records_lost",
+    # pipeline services
+    "inbound.events_unregistered",
+    "batch.elements_processed",
+    "event_sources.decode_failures",
+    "event_sources.quota_rejected",
+    "event_management.enrich_publish_failures",
+    "device_state.presence_transitions",
+    "schedule.jobs_fired",
+    "command_delivery.delivered",
+    "command_delivery.failed",
+    "registration.devices_registered",
+    "registration.requests_rejected",
+    "registration.unknown_indices",
+    "tenant_updates.malformed",
+    # robustness subsystem
+    "dlq.quarantined",
+    "dlq.publish_failures",
+    "dlq.replayed",
+    "supervisor.restarts",
+    # flow control (FlowController.count families)
+    "flow.admitted",
+    "flow.rejected",
+    "flow.throttled",
+    "flow.fair_granted",
+    "flow.deferred_replayed",
+    "flow.shed_reject",
+    "flow.shed_degrade",
+    "flow.shed_defer",
+)
+
+GAUGES = (
+    "flow.pressure",
+    "flow.shed_level",
+)
+
+METERS = (
+    "scoring.events_scored",
+    "inbound.events_processed",
+    "event_sources.events_received",
+    "event_management.events_persisted",
+    "device_state.events_merged",
+    "outbound.records_forwarded",
+)
+
+HISTOGRAMS = (
+    "scoring.e2e_latency_s",
+    "scoring.batch_latency_s",
+    "scoring.batch_size",
+    "scoring.stage_admit_s",
+    "scoring.stage_batch_s",
+    "scoring.stage_device_s",
+    "scoring.stage_sink_s",
+)
+
+# f-string metric names whose suffix is computed at runtime
+# (FlowController.count builds f"flow.{name}"); MET01 accepts an
+# f-string whose literal prefix matches one of these exactly.
+DYNAMIC_METRIC_PREFIXES = ("flow.",)
+
+# name -> kind; built with a conflict check so a metric registered under
+# two kinds fails at import (and therefore fails the build / meta-test).
+METRICS: dict[str, str] = {}
+for _kind, _names in (("counter", COUNTERS), ("gauge", GAUGES),
+                      ("meter", METERS), ("histogram", HISTOGRAMS)):
+    for _name in _names:
+        if _name in METRICS:
+            raise ValueError(
+                f"metric {_name!r} registered as both {METRICS[_name]} "
+                f"and {_kind} — one name, one kind")
+        METRICS[_name] = _kind
+del _kind, _names, _name
+
+
+def metric_kind(base_name: str) -> str | None:
+    """Registered kind for a metric base name, or None if unknown."""
+    return METRICS.get(base_name)
+
+
+def is_fault_site(site: str) -> bool:
+    return site in FAULT_SITES

@@ -9,6 +9,7 @@ rebuild ships one:
   swx demo                                         run + simulate + score, one process
   swx dlq list|replay --tenant T                   inspect/replay dead letters
   swx quota show|set --tenant T                    flow-control quotas
+  swx lint [--format json]                         static invariant checks
 
 `run` starts every service, creates tenants from the YAML (or a default
 tenant), and serves REST until interrupted.
@@ -744,6 +745,27 @@ def main(argv=None) -> int:
     p_quota.add_argument("--user", default="admin")
     p_quota.add_argument("--password", default="password")
 
+    p_lint = sub.add_parser(
+        "lint", parents=[common],
+        help="run swxlint, the AST-based invariant checker "
+             "(concurrency/flow-control/fault-site contracts; "
+             "docs/ANALYSIS.md)")
+    p_lint.add_argument("--root",
+                        help="package dir to lint (default: the installed "
+                             "sitewhere_tpu package)")
+    p_lint.add_argument("--format", choices=["text", "json"],
+                        default="text", help="report format")
+    p_lint.add_argument("--baseline",
+                        help="baseline JSON (default: scripts/"
+                             "swxlint-baseline.json next to the package)")
+    p_lint.add_argument("--write-baseline", action="store_true",
+                        help="capture current findings as the baseline "
+                             "(reasons must be filled in by hand)")
+    p_lint.add_argument("--dump-registry", action="store_true",
+                        help="print the discovered fault-site/metric "
+                             "literal inventory (registry regeneration "
+                             "aid)")
+
     p_demo = sub.add_parser("demo", parents=[common], help="one-process end-to-end demo")
     p_demo.add_argument("--devices", type=int, default=1000)
     p_demo.add_argument("--seconds", type=float, default=5.0)
@@ -774,6 +796,11 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
+    if args.cmd == "lint":
+        # dependency-free static analysis: never touches jax/the backend
+        from sitewhere_tpu.analysis.__main__ import run as lint_run
+
+        return lint_run(args)
     if args.cmd == "bench":
         import subprocess
 

@@ -25,10 +25,13 @@ faults disabled is within noise of pre-PR).
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from dataclasses import dataclass, field
 from typing import Optional
+
+logger = logging.getLogger(__name__)
 
 
 class FaultInjected(RuntimeError):
@@ -62,6 +65,18 @@ class FaultInjector:
         (capped at `max_faults` total injections when >= 0). Chainable."""
         if mode not in ("raise", "delay"):
             raise ValueError(f"unknown fault mode {mode!r}")
+        if __debug__:
+            # debug-mode cross-check against the static registry (swx
+            # lint FLT01 checks consults; this keeps the runtime and
+            # static views in sync): arming a site no code consults is
+            # a chaos test that silently tests nothing
+            from sitewhere_tpu.analysis.registry import FAULT_SITES
+
+            if site not in FAULT_SITES:
+                logger.warning(
+                    "fault site %r is not in the central registry "
+                    "(sitewhere_tpu/analysis/registry.py FAULT_SITES) — "
+                    "no instrumented call site will consult it", site)
         self._sites[site] = _Site(
             rate=rate, mode=mode, delay_s=delay_s, max_faults=max_faults,
             rng=random.Random(f"{self.seed}:{site}"))

@@ -187,18 +187,37 @@ class TenantEngineManager(BackgroundTaskComponent):
                 if tenant.tenant_id not in self.service.engines:
                     await self.service.start_tenant_engine(tenant)
             while True:
-                for record in await consumer.poll(timeout=0.5):
-                    update = record.value
-                    action, tenant = update["action"], update["tenant"]
+                # control topic: instance-level records have no tenant
+                # DLQ to quarantine to — malformed updates are counted
+                # and skipped instead (per-record isolation either way)
+                for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                    try:
+                        update = record.value
+                        action, tenant = update["action"], update["tenant"]
+                    except (TypeError, KeyError) as exc:
+                        # a malformed broadcast must not crash the
+                        # manager (and re-crash it on every supervised
+                        # restart until the budget drains)
+                        logger.warning(
+                            "%s: malformed tenant-model update %r: %s",
+                            self.service.identifier, record.value, exc)
+                        runtime.metrics.counter(
+                            "tenant_updates.malformed").inc()
+                        continue
+                    # a wrong-typed `tenant` (e.g. a bare id string) has
+                    # both keys and passes the guard above — resolve the
+                    # label once, safely, so the isolation handler below
+                    # can't itself raise on `tenant.tenant_id` and
+                    # restart-loop the manager on the same record
+                    tid = getattr(tenant, "tenant_id", tenant)
                     try:
                         if action in ("created", "updated"):
                             await self.service.start_tenant_engine(tenant)
                         elif action == "deleted":
-                            await self.service.stop_tenant_engine(tenant.tenant_id)
+                            await self.service.stop_tenant_engine(tid)
                     except Exception:  # noqa: BLE001 - engine error is isolated
                         logger.exception("%s: tenant %s %s failed",
-                                         self.service.identifier,
-                                         tenant.tenant_id, action)
+                                         self.service.identifier, tid, action)
                 consumer.commit()
         finally:
             consumer.close()

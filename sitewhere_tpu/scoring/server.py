@@ -636,7 +636,10 @@ class ScoringSession:
         if self._pending_n == 0:
             return None
         if self.faults is not None:
-            self.faults.check("scoring.dispatch")
+            # acheck, not check: a delay-mode fault must suspend this
+            # coroutine, not the event loop (sync flush_nowait keeps
+            # check() — it has no loop to block)
+            await self.faults.acheck("scoring.dispatch")
         dev, val, ts, ingest, ctx, traces = self._take_pending()
         futs: list[asyncio.Future] = []
         _, failed = self._dispatch_chunks(dev, val, ts, ingest, ctx,

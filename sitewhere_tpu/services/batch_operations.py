@@ -182,8 +182,9 @@ class BatchElementProcessor(BackgroundTaskComponent):
         try:
             while True:
                 for record in await consumer.poll(max_records=16, timeout=0.5):
-                    chunk = record.value
+                    chunk = None
                     try:
+                        chunk = record.value
                         if not isinstance(chunk, dict) \
                                 or "operation_id" not in chunk:
                             # a non-chunk on the elements topic used to

@@ -377,15 +377,15 @@ class CommandDeliveryManager(BackgroundTaskComponent):
         try:
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
-                    value = record.value
-                    if not isinstance(value, list):
-                        continue
                     # poison quarantine: per-delivery failures already
                     # route to the undelivered topic; anything escaping
                     # that (a malformed invocation list, a broken
                     # undelivered produce) quarantines the record so
                     # command routing keeps draining
                     try:
+                        value = record.value
+                        if not isinstance(value, list):
+                            continue
                         for ev in value:
                             if isinstance(ev, DeviceCommandInvocation):
                                 ok = await self._deliver(dm, ev)

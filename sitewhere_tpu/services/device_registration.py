@@ -22,6 +22,7 @@ Tenant config section `device-registration`:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from sitewhere_tpu.config import TenantConfig
@@ -72,20 +73,31 @@ class RegistrationManager(BackgroundTaskComponent):
         try:
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
-                    value = record.value
-                    if isinstance(value, RegistrationBatch):
-                        ack = self._register(dm, value)
-                        n = sum(1 for s in ack.status if s == ACK_NEW)
-                        registered.inc(n)
-                        n_rej = sum(1 for s in ack.status if s == ACK_REJECTED)
-                        if n_rej:
-                            rejected.inc(n_rej)
-                        # compact agent protocol round trip: the binary
-                        # ack rides the device's command route (reference:
-                        # RegistrationAck down the MQTT command topic)
-                        await self._send_acks(dm, ack)
-                    elif isinstance(value, dict) and "device_indices" in value:
-                        unknown_idx.inc(len(value["device_indices"]))
+                    # poison quarantine: a registration whose policy
+                    # lookup/creation raises goes to the tenant DLQ —
+                    # one malformed request must not stop the tenant's
+                    # auto-registration path (found by swx lint DLQ01)
+                    try:
+                        value = record.value
+                        if isinstance(value, RegistrationBatch):
+                            ack = self._register(dm, value)
+                            n = sum(1 for s in ack.status if s == ACK_NEW)
+                            registered.inc(n)
+                            n_rej = sum(
+                                1 for s in ack.status if s == ACK_REJECTED)
+                            if n_rej:
+                                rejected.inc(n_rej)
+                            # compact agent protocol round trip: the binary
+                            # ack rides the device's command route (reference:
+                            # RegistrationAck down the MQTT command topic)
+                            await self._send_acks(dm, ack)
+                        elif isinstance(value, dict) \
+                                and "device_indices" in value:
+                            unknown_idx.inc(len(value["device_indices"]))
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 - quarantined
+                        await engine.dead_letter(record, exc, self.path)
                 consumer.commit()
         finally:
             consumer.close()

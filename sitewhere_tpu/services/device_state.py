@@ -145,10 +145,10 @@ class StateMerger(BackgroundTaskComponent):
         try:
             while True:
                 for record in await consumer.poll(max_records=256, timeout=0.2):
-                    batch = record.value
                     # poison quarantine: a batch the merge rejects goes
                     # to the tenant DLQ; state merging keeps flowing
                     try:
+                        batch = record.value
                         if isinstance(batch, MeasurementBatch):
                             engine.merge_measurements(batch)
                             merged.mark(len(batch))

@@ -153,8 +153,10 @@ class EventPersister(BackgroundTaskComponent):
                     # the batch is already persisted: a failed enriched
                     # re-publish must NOT dead-letter it (replay would
                     # run it through the persister again and store the
-                    # events twice) — count the lost enrichment instead
-                    try:
+                    # events twice) — count the lost enrichment instead.
+                    # DLQ01-disabled for that reason: the broad handler
+                    # below never raises, so the loop still survives
+                    try:  # swxlint: disable=DLQ01
                         await runtime.bus.produce(enriched_topic,
                                                   record.value,
                                                   key=record.key)

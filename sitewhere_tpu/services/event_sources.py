@@ -216,7 +216,9 @@ class QueueEventReceiver(BackgroundTaskComponent):
         self.queue.put_nowait((payload, time.monotonic()))
         return True
 
-    async def _run(self) -> None:
+    # queued payloads were already charged at submit()/submit_nowait();
+    # charging again here would double-bill every event
+    async def _run(self) -> None:  # swxlint: disable=FLW01
         while True:
             payload, t_in = await self.queue.get()
             await self.engine.process_payload(payload, self.name, self.decoder,
@@ -723,7 +725,10 @@ class EventSourcesEngine(TenantEngine):
         self._quota_rejected.inc()
         return max(decision.retry_after, 0.001)
 
-    async def process_payload(self, payload: bytes, source: str,
+    # the shared POST-admission sink: every receiver charges
+    # admit_ingress() before invoking this (swx lint FLW01 enforces
+    # that at each call site) — charging here too would double-bill
+    async def process_payload(self, payload: bytes, source: str,  # swxlint: disable=FLW01
                               decoder: EventDecoder,
                               ingest_monotonic: Optional[float] = None) -> None:
         tracer = self.runtime.tracer

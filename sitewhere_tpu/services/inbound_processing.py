@@ -66,25 +66,30 @@ class InboundProcessor(BackgroundTaskComponent):
                 if dm_service is not None:
                     dm = dm_service.engines.get(tenant_id, dm)
                 for record in await consumer.poll(max_records=256, timeout=0.2):
-                    # weighted-fair admission (kernel/flow.py): instead of
-                    # handling records FIFO off the bus, each batch is
-                    # admitted through the instance's DRR scheduler — with
-                    # flow_inbound_rate capped, a hog tenant's backlog
-                    # drains in proportion to its weight, not its depth
-                    # (uncapped instances pass through untouched)
-                    if flow is not None:
-                        try:
-                            cost = float(len(record.value))
-                        except TypeError:
-                            cost = 1.0
-                        await flow.admit_fair(tenant_id, max(cost, 1.0))
                     # poison quarantine: a record whose handling raises
                     # goes to the tenant DLQ (with provenance) and the
                     # loop keeps draining — one bad record must never
-                    # kill the tenant's whole inbound path
+                    # kill the tenant's whole inbound path. Admission
+                    # lives inside the wrapper too: a record whose cost
+                    # estimate blows up is itself poison
                     try:
+                        # weighted-fair admission (kernel/flow.py):
+                        # instead of handling records FIFO off the bus,
+                        # each batch is admitted through the instance's
+                        # DRR scheduler — with flow_inbound_rate capped,
+                        # a hog tenant's backlog drains in proportion to
+                        # its weight, not its depth (uncapped instances
+                        # pass through untouched)
+                        if flow is not None:
+                            try:
+                                cost = float(len(record.value))
+                            except TypeError:
+                                cost = 1.0
+                            await flow.admit_fair(tenant_id, max(cost, 1.0))
                         if runtime.faults is not None:
-                            runtime.faults.check("inbound.handle")
+                            # acheck, not check: a delay-mode fault must
+                            # suspend this coroutine, not the event loop
+                            await runtime.faults.acheck("inbound.handle")
                         await self._handle(record, dm, runtime, tenant_id,
                                            inbound_topic, unregistered_topic,
                                            processed, dropped)

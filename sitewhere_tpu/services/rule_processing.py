@@ -376,11 +376,11 @@ class RuleProcessor(BackgroundTaskComponent):
                     lost_counter.inc(lost - lost_seen)
                     lost_seen = lost
                 for record in records:
-                    value = record.value
                     # poison quarantine: an admit the scorer rejects
                     # (malformed batch) dead-letters the record; the
                     # tenant's scoring path keeps flowing
                     try:
+                        value = record.value
                         if sink is not None and isinstance(value,
                                                            MeasurementBatch):
                             # shed routing: flow.shed_mode is also the
@@ -446,9 +446,9 @@ class RuleProcessor(BackgroundTaskComponent):
                             group=f"{tenant_id}.deferred-replay")
                     replayed = deferred_consumer.poll_nowait(max_records=8)
                     for rec in replayed:
-                        if not isinstance(rec.value, MeasurementBatch):
-                            continue
                         try:
+                            if not isinstance(rec.value, MeasurementBatch):
+                                continue
                             sink.admit(rec.value)
                             flow.count("deferred_replayed", tenant_id,
                                        len(rec.value))

@@ -1,0 +1,509 @@
+"""swxlint (sitewhere_tpu/analysis): fixture tests per checker —
+positive (the failing-fixture demonstrations the acceptance asks for),
+negative, suppressed, baselined — plus the meta-test that the live
+codebase is lint-clean modulo its checked-in baseline."""
+
+import json
+import logging
+import textwrap
+
+from sitewhere_tpu.analysis import FAULT_SITES, METRICS, lint_package, lint_sources
+from sitewhere_tpu.analysis.engine import Baseline
+from sitewhere_tpu.analysis.registry import (
+    COUNTERS,
+    GAUGES,
+    HISTOGRAMS,
+    METERS,
+)
+
+SVC = "sitewhere_tpu/services/somesvc.py"          # non-ingress module
+INGRESS = "sitewhere_tpu/services/event_sources.py"  # ingress module
+
+
+def _codes(report):
+    return [f.code for f in report.findings]
+
+
+def _lint(src, path=SVC, baseline=None):
+    return lint_sources({path: textwrap.dedent(src)}, baseline=baseline)
+
+
+# -- ASY01 -------------------------------------------------------------------
+
+
+def test_asy01_time_sleep_in_async_def():
+    rep = _lint("""
+        import time
+
+        async def poll():
+            time.sleep(0.1)
+    """)
+    assert _codes(rep) == ["ASY01"]
+    assert "time.sleep" in rep.findings[0].message
+    assert rep.findings[0].qualname == "poll"
+
+
+def test_asy01_resolves_import_aliases():
+    rep = _lint("""
+        from time import sleep as zzz
+
+        async def f():
+            zzz(1)
+    """)
+    assert _codes(rep) == ["ASY01"]
+
+
+def test_asy01_requests_and_sync_faults_check():
+    rep = _lint("""
+        import requests
+
+        class C:
+            async def handle(self):
+                self.faults.check("inbound.handle")
+                return requests.get("http://x")
+    """)
+    assert _codes(rep) == ["ASY01", "ASY01"]
+    assert any("acheck" in f.hint for f in rep.findings)
+
+
+def test_asy01_negative_async_sleep_and_sync_def():
+    rep = _lint("""
+        import asyncio
+        import time
+
+        def warmup():
+            time.sleep(0.1)      # sync context: fine
+
+        async def f():
+            await asyncio.sleep(0.1)
+
+            def in_thread():     # nested sync scope: skipped
+                time.sleep(1.0)
+            await asyncio.to_thread(in_thread)
+    """)
+    assert _codes(rep) == []
+
+
+def test_asy01_suppressed_same_line():
+    rep = _lint("""
+        import time
+
+        async def f():
+            time.sleep(0.01)  # swxlint: disable=ASY01 - test fixture
+    """)
+    assert _codes(rep) == []
+    assert len(rep.suppressed) == 1
+
+
+# -- FLW01 -------------------------------------------------------------------
+
+
+def test_flw01_publish_without_flow_consult():
+    rep = _lint("""
+        class Recv:
+            async def on_message(self, payload):
+                await self.engine.process_payload(payload, self.name, self.d)
+    """, path=INGRESS)
+    assert _codes(rep) == ["FLW01"]
+    assert rep.findings[0].qualname == "Recv.on_message"
+
+
+def test_flw01_produce_without_consult_in_rest_module():
+    rep = _lint("""
+        class Api:
+            async def ingest(self, req):
+                await self.runtime.bus.produce("topic", req.json())
+    """, path="sitewhere_tpu/rest/api.py")
+    assert _codes(rep) == ["FLW01"]
+
+
+def test_flw01_negative_with_admit_on_same_path():
+    rep = _lint("""
+        class Recv:
+            async def on_message(self, payload):
+                if self.engine.admit_ingress(payload) > 0:
+                    return False
+                await self.engine.process_payload(payload, self.name, self.d)
+    """, path=INGRESS)
+    assert _codes(rep) == []
+
+
+def test_flw01_only_applies_to_ingress_modules():
+    rep = _lint("""
+        class Loop:
+            async def run(self):
+                await self.bus.produce("scored-events", {})
+    """, path=SVC)
+    assert _codes(rep) == []
+
+
+def test_flw01_suppressed_on_def_line():
+    rep = _lint("""
+        class Recv:
+            async def drain(self):  # swxlint: disable=FLW01 - charged at submit
+                await self.engine.process_payload(self.q.get(), "n", self.d)
+    """, path=INGRESS)
+    assert _codes(rep) == []
+    assert len(rep.suppressed) == 1
+
+
+# -- DLQ01 -------------------------------------------------------------------
+
+_NAKED_LOOP = """
+    class Worker:
+        async def _run(self):
+            consumer = self.bus.subscribe("t")
+            while True:
+                for record in await consumer.poll(timeout=0.5):
+                    self.handle(record)
+                consumer.commit()
+"""
+
+
+def test_dlq01_naked_poll_loop():
+    rep = _lint(_NAKED_LOOP)
+    assert _codes(rep) == ["DLQ01"]
+    assert "dead_letter" in rep.findings[0].hint
+
+
+def test_dlq01_poll_assigned_to_variable():
+    rep = _lint("""
+        class Worker:
+            async def _run(self):
+                while True:
+                    records = await self.consumer.poll(max_records=64)
+                    for record in records:
+                        self.handle(record)
+    """)
+    assert _codes(rep) == ["DLQ01"]
+
+
+def test_dlq01_negative_quarantined_loop():
+    rep = _lint("""
+        import asyncio
+
+        class Worker:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                while True:
+                    for record in await consumer.poll(timeout=0.5):
+                        try:
+                            self.handle(record)
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as exc:
+                            await self.engine.dead_letter(record, exc, self.path)
+                    consumer.commit()
+    """)
+    assert _codes(rep) == []
+
+
+def test_dlq01_narrow_catch_is_not_enough():
+    # except ValueError -> dead_letter still lets any other poison kill
+    # the loop; the contract wants the broad catch
+    rep = _lint("""
+        class Worker:
+            async def _run(self):
+                for record in await self.consumer.poll(timeout=0.5):
+                    try:
+                        self.handle(record)
+                    except ValueError as exc:
+                        await self.engine.dead_letter(record, exc, self.path)
+    """)
+    assert _codes(rep) == ["DLQ01"]
+
+
+def test_dlq01_record_touched_outside_wrapper():
+    # the wrapper exists, but a decode BEFORE it re-opens the hole: a
+    # poison record raising in decode() still kills the consumer
+    rep = _lint("""
+        class Worker:
+            async def _run(self):
+                for record in await self.consumer.poll(timeout=0.5):
+                    value = self.decode(record)
+                    try:
+                        self.handle(value)
+                    except Exception as exc:
+                        await self.engine.dead_letter(record, exc, self.path)
+    """)
+    assert _codes(rep) == ["DLQ01"]
+    assert "outside" in rep.findings[0].message
+
+
+def test_dlq01_suppressed_on_for_line():
+    rep = _lint("""
+        class Manager:
+            async def _run(self):
+                for record in await self.consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                    self.apply(record)
+    """)
+    assert _codes(rep) == []
+    assert len(rep.suppressed) == 1
+
+
+# -- FLT01 -------------------------------------------------------------------
+
+
+def test_flt01_unknown_site_and_typo():
+    rep = _lint("""
+        class C:
+            def admit(self):
+                self.faults.check("flow.admitt")
+
+            async def handle(self):
+                await self.faults.acheck("no.such.site")
+    """)
+    assert _codes(rep) == ["FLT01", "FLT01"]
+
+
+def test_flt01_arm_with_computed_site():
+    rep = _lint("""
+        def chaos(fi, site):
+            fi.arm(site, rate=0.5)
+    """)
+    assert _codes(rep) == ["FLT01"]
+    assert "literal" in rep.findings[0].message
+
+
+def test_flt01_negative_known_sites():
+    rep = _lint("""
+        class C:
+            def admit(self):
+                if self.faults is not None:
+                    self.faults.check("flow.admit")
+
+            async def produce(self):
+                await self.faults.acheck("bus.produce")
+    """)
+    assert _codes(rep) == []
+
+
+def test_flt01_ignores_non_injector_receivers():
+    rep = _lint("""
+        def f(conn):
+            conn.check("not a fault site")
+    """)
+    assert _codes(rep) == []
+
+
+# -- MET01 -------------------------------------------------------------------
+
+
+def test_met01_typo_metric_name():
+    rep = _lint("""
+        class C:
+            def count(self):
+                self.metrics.counter("flow.admited").inc()
+    """)
+    assert _codes(rep) == ["MET01"]
+
+
+def test_met01_kind_conflict():
+    rep = _lint("""
+        class C:
+            def broken(self):
+                self.metrics.gauge("dlq.quarantined").set(1)
+    """)
+    assert _codes(rep) == ["MET01"]
+    assert "registered as a counter" in rep.findings[0].message
+
+
+def test_met01_computed_name_is_flagged():
+    rep = _lint("""
+        class C:
+            def count(self, prefix):
+                self.metrics.counter(prefix + ".events").inc()
+    """)
+    assert _codes(rep) == ["MET01"]
+
+
+def test_met01_negative_literals_fstrings_and_families():
+    rep = _lint("""
+        class C:
+            def ok(self, metrics, tenant_id, name):
+                metrics.counter("dlq.quarantined").inc()
+                metrics.counter(f"dlq.quarantined:{tenant_id}").inc()
+                metrics.gauge(f"flow.pressure:{tenant_id}").set(0.5)
+                metrics.counter(f"flow.{name}").inc()          # dynamic family
+                metrics.histogram("scoring.e2e_latency_s")
+                self.registry.counter("anything")  # not the metrics registry
+    """)
+    assert _codes(rep) == []
+
+
+# -- LIF01 -------------------------------------------------------------------
+
+
+def test_lif01_stop_without_super():
+    rep = _lint("""
+        class Recv(LifecycleComponent):
+            async def stop(self, monitor=None):
+                await self.listener.stop()
+    """)
+    assert _codes(rep) == ["LIF01"]
+    assert "super().stop" in rep.findings[0].message
+
+
+def test_lif01_do_stop_without_super_transitive():
+    # Leaf inherits BackgroundTaskComponent through Mid: the owned task
+    # is never cancelled if _do_stop does not chain
+    rep = _lint("""
+        class Mid(BackgroundTaskComponent):
+            pass
+
+        class Leaf(Mid):
+            async def _do_stop(self, monitor):
+                await self.listener.stop()
+    """)
+    assert _codes(rep) == ["LIF01"]
+    assert rep.findings[0].qualname == "Leaf._do_stop"
+
+
+def test_lif01_negative_chained_and_hooks():
+    rep = _lint("""
+        class Recv(BackgroundTaskComponent):
+            async def _do_stop(self, monitor):
+                await super()._do_stop(monitor)
+                await self.listener.stop()
+
+            async def stop(self, monitor=None):
+                await super().stop(monitor)
+
+        class Plain(LifecycleComponent):
+            async def _do_stop(self, monitor):
+                pass   # plain lifecycle: the base hook is a no-op
+
+        class Unrelated:
+            async def stop(self):
+                pass   # not a lifecycle component at all
+    """)
+    assert _codes(rep) == []
+
+
+# -- baseline workflow -------------------------------------------------------
+
+
+def test_baselined_finding_passes_and_is_reported():
+    bl = Baseline(entries={
+        (SVC, "ASY01", "poll"): "fixture: documented false positive"})
+    rep = _lint("""
+        import time
+
+        async def poll():
+            time.sleep(0.1)
+    """, baseline=bl)
+    assert rep.findings == [] and rep.exit_code == 0
+    assert len(rep.baselined) == 1
+    finding, reason = rep.baselined[0]
+    assert finding.code == "ASY01" and "false positive" in reason
+
+
+def test_baseline_entry_without_reason_is_ignored():
+    raw = {"entries": [
+        {"path": SVC, "code": "ASY01", "qualname": "poll", "reason": ""}]}
+    import pathlib
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = pathlib.Path(d) / "bl.json"
+        p.write_text(json.dumps(raw))
+        bl = Baseline.load(p)
+    assert bl.entries == {} and len(bl.undocumented) == 1
+    rep = _lint("""
+        import time
+
+        async def poll():
+            time.sleep(0.1)
+    """, baseline=bl)
+    assert _codes(rep) == ["ASY01"]   # the mute button did not mute
+
+
+def test_stale_baseline_entries_are_reported():
+    bl = Baseline(entries={
+        (SVC, "DLQ01", "Gone._run"): "was fixed; entry should be pruned"})
+    rep = _lint("async def clean():\n    pass\n", baseline=bl)
+    assert rep.findings == []
+    assert len(rep.stale_baseline) == 1
+    assert rep.stale_baseline[0]["qualname"] == "Gone._run"
+
+
+def test_line_numbers_not_part_of_baseline_fingerprint():
+    bl = Baseline(entries={(SVC, "ASY01", "poll"): "documented"})
+    rep = _lint("""
+        import time
+        # lines
+        # shifted
+        # by
+        # edits
+        async def poll():
+            time.sleep(0.1)
+    """, baseline=bl)
+    assert rep.findings == [] and len(rep.baselined) == 1
+
+
+# -- registry + runtime cross-check ------------------------------------------
+
+
+def test_registry_one_kind_per_name():
+    groups = [set(COUNTERS), set(GAUGES), set(METERS), set(HISTOGRAMS)]
+    for i, a in enumerate(groups):
+        for b in groups[i + 1:]:
+            assert not (a & b), f"metric registered under two kinds: {a & b}"
+    assert len(METRICS) == sum(len(g) for g in groups)
+    assert METRICS["dlq.quarantined"] == "counter"
+    assert "flow.admit" in FAULT_SITES
+
+
+def test_fault_injector_arm_warns_on_unregistered_site(caplog):
+    from sitewhere_tpu.kernel.faults import FaultInjector
+
+    fi = FaultInjector(seed=1)
+    with caplog.at_level(logging.WARNING, logger="sitewhere_tpu.kernel.faults"):
+        fi.arm("bus.poll")
+        assert not caplog.records
+        fi.arm("no.such.site")
+    assert any("no.such.site" in r.getMessage() for r in caplog.records)
+
+
+# -- meta: the live codebase + CLI -------------------------------------------
+
+
+def test_live_codebase_is_lint_clean_modulo_baseline():
+    report = lint_package()
+    assert report.findings == [], "\n" + "\n".join(
+        f.render() for f in report.findings)
+    assert report.stale_baseline == [], report.stale_baseline
+    assert report.undocumented_baseline == []
+    # every baselined finding carries its documented reason
+    assert all(reason.strip() for _, reason in report.baselined)
+
+
+def test_cli_json_report(capsys):
+    from sitewhere_tpu.analysis.__main__ import main
+
+    rc = main(["--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["clean"] is True
+    assert out["checked_files"] > 50
+    assert "findings" in out and out["findings"] == []
+
+
+def test_swx_lint_subcommand(capsys):
+    from sitewhere_tpu.cli import main as cli_main
+
+    rc = cli_main(["lint", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["clean"] is True
+
+
+def test_cli_exit_nonzero_on_findings(tmp_path, capsys):
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n")
+    from sitewhere_tpu.analysis.__main__ import main
+
+    rc = main(["--root", str(bad), "--format", "json",
+               "--baseline", str(tmp_path / "none.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["clean"] is False
+    assert out["findings"][0]["code"] == "ASY01"
