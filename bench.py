@@ -995,6 +995,11 @@ async def run_bench(args) -> dict:
     tenant_ids = ([f"bench{i}" for i in range(args.pooled)] if pooled
                   else ["bench"])
     per_tenant = max(args.devices // len(tenant_ids), 1)
+    # --no-fastlane pins the staged slow lane via the tenant override the
+    # fused ingress fast lane honors (kernel/fastlane.py) — the A/B lever
+    # for measuring the fusion; default lets auto-detection engage it
+    fastlane_section = ({"fastlane": {"enabled": False}}
+                        if args.no_fastlane else {})
     # ONE fleet-size bucket: throughput is inflight × bucket / RTT on the
     # tunneled chip (bigger flushes win) and every extra bucket is another
     # warmup compile. (A CPU bucket ladder was tried for the latency
@@ -1003,6 +1008,7 @@ async def run_bench(args) -> dict:
     buckets = [per_tenant]
     for tid in tenant_ids:
         await rt.add_tenant(TenantConfig(tenant_id=tid, sections={
+            **fastlane_section,
             "event-management": {"history": args.history},
             "rule-processing": {
                 "model": args.model,
@@ -1036,6 +1042,11 @@ async def run_bench(args) -> dict:
                          .receiver("default"))
         eng = rt.api("rule-processing").engine(tid)
         sinks.append(eng.session or eng.pool_slot)
+    # lane actually engaged (derived from the live engines, not the
+    # flag: auto-detection may decline — e.g. scripts in config)
+    fastlane_on = all(
+        getattr(rt.api("rule-processing").engine(tid), "fastlane", None)
+        is not None for tid in tenant_ids)
     # wait for background warmup (bucket compiles) before measuring
     t_warm = time.monotonic()
     while not all(s.ready for s in sinks):
@@ -1234,6 +1245,11 @@ async def run_bench(args) -> dict:
             sum(breakdown[k]["p99_ms"]
                 for k in ("admit", "batch", "sink") if k in breakdown), 3),
         "paced_rate": round(paced_rate, 1),
+        # lane provenance: bus produce→consume edges the scored path
+        # traversed (fused lane admits off the decoded topic = 1 hop;
+        # staged lane rides decoded → inbound → enriched = 3)
+        "fastlane": "on" if fastlane_on else "off",
+        "hops": 1 if fastlane_on else 3,
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),
         "saturation_trials": trials,
@@ -1378,6 +1394,11 @@ def main() -> None:
                         help="max injected faults per site (bounded so "
                              "the 5/60s restart budget is never exceeded "
                              "by design)")
+    parser.add_argument("--no-fastlane", action="store_true",
+                        help="pin the staged slow lane (disable the fused "
+                             "ingress fast lane) — the A/B lever for "
+                             "measuring the hop fusion; see "
+                             "docs/PERFORMANCE.md")
     parser.add_argument("--force-cpu", action="store_true",
                         help="run on the CPU backend (the supervisor uses "
                              "this when the accelerator is unreachable)")

@@ -7,7 +7,10 @@
 #      findings fail the gate before a single test runs, then
 #   3. the ROADMAP.md "Tier-1 verify" command VERBATIM — keep the block
 #      below byte-identical to ROADMAP.md so both audiences run the same
-#      gate.
+#      gate. The pytest sweep includes the fastlane lane-equivalence
+#      suite (tests/test_fastlane.py, unmarked = default tier): the
+#      fused ingress path must stay behaviorally identical to the
+#      staged lane (docs/PERFORMANCE.md) for the gate to pass.
 cd "$(dirname "$0")/.."
 
 python -m compileall -q sitewhere_tpu || exit 1

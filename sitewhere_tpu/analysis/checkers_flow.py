@@ -38,6 +38,9 @@ INGRESS_MODULES = frozenset({
     "sitewhere_tpu/services/event_sources.py",
     "sitewhere_tpu/rest/api.py",
     "sitewhere_tpu/kernel/kafka_endpoint.py",
+    # the fused ingress fast lane publishes validated batches to the
+    # inbound topic — an ingress edge like the staged validator it fuses
+    "sitewhere_tpu/kernel/fastlane.py",
 })
 
 _PUBLISH_ATTRS = {"produce", "process_payload"}

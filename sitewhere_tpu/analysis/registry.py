@@ -27,6 +27,7 @@ FAULT_SITES = frozenset({
     "bus.produce",        # kernel/bus.py EventBus.produce
     "bus.poll",           # kernel/bus.py Consumer.poll_nowait
     "inbound.handle",     # services/inbound_processing.py per-record handle
+    "fastlane.handle",    # kernel/fastlane.py fused per-record handle
     "durable.flush",      # persistence/durable.py spill writer
     "scoring.dispatch",   # scoring/server.py flush paths
     "flow.admit",         # kernel/flow.py ingress admission
@@ -47,6 +48,8 @@ COUNTERS = (
     "scoring.bus_records_lost",
     # pipeline services
     "inbound.events_unregistered",
+    "fastlane.events_unregistered",
+    "fastlane.records_lost",
     "batch.elements_processed",
     "event_sources.decode_failures",
     "event_sources.quota_rejected",
@@ -83,6 +86,7 @@ GAUGES = (
 METERS = (
     "scoring.events_scored",
     "inbound.events_processed",
+    "fastlane.events_processed",
     "event_sources.events_received",
     "event_management.events_persisted",
     "device_state.events_merged",

@@ -71,6 +71,12 @@ class BatchContext:
     source: str = ""
     trace_id: int = 0
     ingest_monotonic: float = field(default_factory=time.monotonic)
+    # set by the fused ingress fast lane (kernel/fastlane.py) when it has
+    # already performed the scoring admit for this batch: the enriched-hop
+    # consumer must not admit it a second time. A declared field (not a
+    # dynamic attribute) because BatchContext is slotted and the flag must
+    # survive the wire codec's field-dict round trip.
+    fastlane: bool = False
 
 
 @dataclass(slots=True)

@@ -14,9 +14,9 @@ the same at-least-once semantics.
 from __future__ import annotations
 
 import asyncio
-import inspect
 import logging
 import time
+from typing import Optional
 
 
 from sitewhere_tpu.config import TenantConfig
@@ -26,6 +26,7 @@ from sitewhere_tpu.domain.batch import (
     RegistrationBatch,
 )
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.fastlane import fastlane_enabled, validate_and_split
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 
@@ -35,8 +36,17 @@ logger = logging.getLogger(__name__)
 class InboundProcessingEngine(TenantEngine):
     def __init__(self, service: "InboundProcessingService", tenant: TenantConfig):
         super().__init__(service, tenant)
-        self.processor = InboundProcessor(self)
-        self.add_child(self.processor)
+        # fused ingress fast lane (kernel/fastlane.py): when the tenant
+        # qualifies, the rule-processing engine's FastLane owns the
+        # decoded topic's consumer group and performs this engine's
+        # validate/split/produce work in the same hop as the scoring
+        # admit — spinning the staged consumer here too would split
+        # partitions with it. Both services evaluate the same predicate
+        # from config + topology, so they always agree on the lane.
+        self.processor: Optional[InboundProcessor] = None
+        if not fastlane_enabled(tenant, self.runtime):
+            self.processor = InboundProcessor(self)
+            self.add_child(self.processor)
 
 
 class InboundProcessor(BackgroundTaskComponent):
@@ -106,17 +116,17 @@ class InboundProcessor(BackgroundTaskComponent):
         batch = record.value
         t_span = time.monotonic()
         if isinstance(batch, (MeasurementBatch, LocationBatch)):
-            mask = dm.registered_mask(batch.device_index)
-            if inspect.isawaitable(mask):
-                mask = await mask  # device-mgmt in a peer process
-            n_bad = int((~mask).sum())
-            if n_bad:
-                dropped.inc(n_bad)
-                bad = batch.device_index[~mask]
-                await runtime.bus.produce(
-                    unregistered_topic,
-                    {"device_indices": bad, "ctx": batch.ctx})
-                batch = batch.select(mask)
+            ctx = batch.ctx
+            if getattr(ctx, "fastlane", False):
+                # stale fast-lane flag: a record the fused lane handled
+                # (mutating the shared ctx in the decoded-topic log) can
+                # redeliver HERE after a lane toggle — left set, the rule
+                # processor would skip its scoring admit and the events
+                # would silently never score. The staged lane claims the
+                # batch for enriched-hop admission.
+                ctx.fastlane = False
+            batch = await validate_and_split(batch, dm, runtime,
+                                             unregistered_topic, dropped)
             if len(batch):
                 processed.mark(len(batch))
                 await runtime.bus.produce(inbound_topic, batch,

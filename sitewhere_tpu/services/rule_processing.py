@@ -35,7 +35,6 @@ Two scoring modes [SURVEY.md §7 hard part b]:
 from __future__ import annotations
 
 import asyncio
-import inspect
 import logging
 import time
 from dataclasses import dataclass
@@ -46,6 +45,11 @@ import numpy as np
 from sitewhere_tpu.config import TenantConfig
 from sitewhere_tpu.domain.batch import AlertBatch, MeasurementBatch, ScoredBatch
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.fastlane import (
+    FastLane,
+    checkpoint_commit,
+    fastlane_enabled,
+)
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.models.registry import build_model
@@ -135,6 +139,15 @@ class RuleProcessingEngine(TenantEngine):
                           GeofenceHook(self.runtime, self.tenant_id, fences))
         self.processor = RuleProcessor(self)
         self.add_child(self.processor)
+        # fused ingress fast lane (kernel/fastlane.py): when the tenant's
+        # shape permits, this engine ALSO consumes the decoded topic and
+        # performs fair-admission + mask validation + scoring admit in
+        # one hop; inbound-processing evaluates the same predicate and
+        # skips its staged consumer for this tenant
+        self.fastlane: Optional[FastLane] = None
+        if fastlane_enabled(tenant, self.runtime):
+            self.fastlane = FastLane(self)
+            self.add_child(self.fastlane)
 
     async def _do_initialize(self, monitor) -> None:
         if not self.model_name:
@@ -176,6 +189,35 @@ class RuleProcessingEngine(TenantEngine):
             await self.pool_slot.drain(timeout=10.0)
             self.pool_slot.pool.unregister(self.tenant_id)
             self.pool_slot = None
+
+    async def shed_route(self, batch: MeasurementBatch, sink,
+                         key: Optional[str] = None) -> None:
+        """Shed-mode routed scoring admit — ONE policy for the staged
+        consumer and the fused fast lane (kernel/fastlane.py), so the
+        lanes cannot diverge on it: ok → admit, degrade → host-side
+        fallback (model_version -1), defer → spool to the durable
+        deferred topic (drained back by the rule processor once
+        pressure clears). `flow.shed_mode` is also the "flow.shed"
+        chaos site — an injected fault propagates to the caller's
+        per-record quarantine like any other failure."""
+        flow = self.runtime.flow
+        shed = flow.shed_mode(self.tenant_id) if flow is not None else "ok"
+        if shed == "defer" and not hasattr(self.runtime.bus, "peek"):
+            # wire-bus process: the deferred drain can't run here (no
+            # poll_nowait), so spooling would strand events until
+            # retention trims them — degrade instead
+            shed = "degrade"
+        if shed == "defer":
+            await self.runtime.bus.produce(
+                self.tenant_topic(TopicNaming.DEFERRED_EVENTS), batch,
+                key=key)
+            flow.count_shed(self.tenant_id, "defer", len(batch))
+        elif shed == "degrade":
+            scored = self.degraded_score(batch)
+            flow.count_shed(self.tenant_id, "degrade", len(batch))
+            await self._deliver_scored(scored)
+        else:
+            sink.admit(batch)
 
     async def _deliver_scored(self, scored: ScoredBatch) -> None:
         """Pool flush sink: publish scored events + emit anomaly alerts
@@ -382,35 +424,18 @@ class RuleProcessor(BackgroundTaskComponent):
                     try:
                         value = record.value
                         if sink is not None and isinstance(value,
-                                                           MeasurementBatch):
-                            # shed routing: flow.shed_mode is also the
-                            # "flow.shed" chaos site — an injected fault
-                            # here quarantines the record like any other
+                                                           MeasurementBatch) \
+                                and not getattr(value.ctx, "fastlane",
+                                                False):
+                            # fastlane-flagged batches were already
+                            # admitted (and shed-routed) in the fused
+                            # hop; hooks below still run either way.
+                            # shed_route is the shared lane policy —
+                            # an injected "flow.shed" fault inside it
+                            # quarantines the record like any other
                             # per-record failure
-                            shed = (flow.shed_mode(tenant_id)
-                                    if flow is not None else "ok")
-                            if shed == "defer" and not hasattr(
-                                    runtime.bus, "peek"):
-                                # wire-bus process: the deferred drain
-                                # below can't run here (no poll_nowait),
-                                # so spooling would strand events until
-                                # retention trims them — degrade instead
-                                shed = "degrade"
-                            if shed == "defer":
-                                # spool to the durable deferred topic;
-                                # drained back through admission once the
-                                # overload clears (below)
-                                await runtime.bus.produce(
-                                    deferred_topic, value, key=record.key)
-                                flow.count_shed(tenant_id, "defer",
-                                                len(value))
-                            elif shed == "degrade":
-                                scored = engine.degraded_score(value)
-                                flow.count_shed(tenant_id, "degrade",
-                                                len(value))
-                                await engine._deliver_scored(scored)
-                            else:
-                                sink.admit(value)
+                            await engine.shed_route(value, sink,
+                                                    key=record.key)
                     except asyncio.CancelledError:
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
@@ -460,22 +485,11 @@ class RuleProcessor(BackgroundTaskComponent):
                         deferred_consumer.commit()
                 # at-least-once without commit starvation: when the sink
                 # is idle, commit directly; under steady pipelined load,
-                # snapshot positions whenever nothing sits unflushed and
-                # commit that snapshot once every flush dispatched before
-                # it has settled AND published (settled_through barrier).
-                # A crash redelivers at most the unsettled tail.
-                if sink is None or sink.idle:
-                    consumer.commit()
-                    ckpt = None
-                else:
-                    if ckpt is not None and sink.settled_through >= ckpt[0]:
-                        consumer.commit(ckpt[1])
-                        ckpt = None
-                    if ckpt is None and sink.pending_n == 0:
-                        snap = consumer.snapshot_positions()
-                        if inspect.isawaitable(snap):
-                            snap = await snap  # consumer on a wire bus
-                        ckpt = (sink.dispatch_count, snap)
+                # the shared checkpoint barrier (kernel/fastlane.py —
+                # one implementation for both lanes) commits snapshots
+                # once everything dispatched before them has settled
+                # AND published. A crash redelivers the unsettled tail.
+                ckpt = await checkpoint_commit(consumer, sink, ckpt)
         finally:
             if deferred_consumer is not None:
                 deferred_consumer.close()

@@ -223,3 +223,45 @@ def test_new_group_on_trimmed_topic_is_not_lost_records(run):
         assert c2.lost_records == first
 
     run(main())
+
+
+def test_poll_truncated_backlog_returns_immediately(run):
+    """Regression (ISSUE 5 satellite): a backlog deeper than
+    `max_records` drains in successive immediate polls — truncation must
+    never make a poll sit out its timeout slice while records are
+    already available, and a produce must wake a blocked poll without
+    waiting out the slice either."""
+
+    async def main():
+        import time
+
+        bus = EventBus(default_partitions=4)
+        c = bus.subscribe("t", group="g")
+        for i in range(600):
+            await bus.produce("t", i, key=str(i))
+        t0 = time.monotonic()
+        total, rounds = 0, 0
+        while total < 600:
+            records = await c.poll(max_records=256, timeout=5.0)
+            assert records, "records available but poll returned empty"
+            total += len(records)
+            assert len(records) <= 256
+            rounds += 1
+        # 3 truncated rounds over a 600-record backlog, none of which
+        # may await the 5 s timeout slice
+        assert rounds >= 3
+        assert time.monotonic() - t0 < 1.0
+
+        # event-driven wakeup: a produce 50 ms in wakes the poll well
+        # inside its 5 s slice (no timeout-granularity stall)
+        async def late_produce():
+            await asyncio.sleep(0.05)
+            await bus.produce("t", "late")
+
+        asyncio.get_running_loop().create_task(late_produce())
+        t0 = time.monotonic()
+        records = await c.poll(max_records=256, timeout=5.0)
+        assert [r.value for r in records] == ["late"]
+        assert time.monotonic() - t0 < 1.0
+
+    run(main())

@@ -277,7 +277,14 @@ def test_chaos_pipeline_drains_and_stops(run, tmp_path):
             fi.arm("bus.poll", rate=0.02, max_faults=3)
             fi.arm("scoring.dispatch", rate=0.3, max_faults=3)
             fi.arm("durable.flush", rate=0.5, max_faults=3)
+            # with rule-processing co-resident the fused fast lane
+            # (kernel/fastlane.py) owns the decoded hop and consults its
+            # own site; arm both so the per-record poison path fires
+            # whichever lane handles the records (rate 0.1: the injector
+            # is per-site seeded — fastlane.handle's seed-42 draw
+            # sequence first fires within 40 records at ≥0.08)
             fi.arm("inbound.handle", rate=0.03, max_faults=2)
+            fi.arm("fastlane.handle", rate=0.1, max_faults=2)
 
             n_batches, per_batch = 40, 32
             for k in range(n_batches):
@@ -289,9 +296,13 @@ def test_chaos_pipeline_drains_and_stops(run, tmp_path):
             sent = n_batches * per_batch
 
             def quarantined():
+                # decoded-hop quarantines carry the handling lane's
+                # provenance: the staged inbound processor or the fused
+                # fast lane (which serves this tenant here)
                 return sum(len(e["value"]) for _, e in
                            list_dead_letters(rt.bus, dlq, limit=-1)
-                           if "inbound-processor" in e["stage"])
+                           if "inbound-processor" in e["stage"]
+                           or "fastlane" in e["stage"])
 
             # every event is accounted for: persisted or quarantined
             # (crash/restart redelivery may persist a record twice —
