@@ -1000,6 +1000,11 @@ async def run_bench(args) -> dict:
     # for measuring the fusion; default lets auto-detection engage it
     fastlane_section = ({"fastlane": {"enabled": False}}
                         if args.no_fastlane else {})
+    # --no-egress-fusion / --egress-lanes: the egress A/B + sharding
+    # levers (kernel/egresslane.py) — fused publish off the flush path,
+    # N consumer loops per group (lanes ≤ bus partitions are useful)
+    egress_section = {"egress": {"fused": not args.no_egress_fusion,
+                                 "lanes": max(args.egress_lanes, 1)}}
     # ONE fleet-size bucket: throughput is inflight × bucket / RTT on the
     # tunneled chip (bigger flushes win) and every extra bucket is another
     # warmup compile. (A CPU bucket ladder was tried for the latency
@@ -1009,6 +1014,7 @@ async def run_bench(args) -> dict:
     for tid in tenant_ids:
         await rt.add_tenant(TenantConfig(tenant_id=tid, sections={
             **fastlane_section,
+            **egress_section,
             "event-management": {"history": args.history},
             "rule-processing": {
                 "model": args.model,
@@ -1047,6 +1053,16 @@ async def run_bench(args) -> dict:
     fastlane_on = all(
         getattr(rt.api("rule-processing").engine(tid), "fastlane", None)
         is not None for tid in tenant_ids)
+    # egress provenance from the live engines (like fastlane_on: the
+    # engaged state, not the flag)
+    egress_on = all(
+        getattr(rt.api("rule-processing").engine(tid), "egress", None)
+        is not None for tid in tenant_ids)
+    egress_lanes_live = max(args.egress_lanes, 1)
+    if egress_on:
+        egress_lanes_live = max(
+            rt.api("rule-processing").engine(tid).egress.lanes
+            for tid in tenant_ids)
     # wait for background warmup (bucket compiles) before measuring
     t_warm = time.monotonic()
     while not all(s.ready for s in sinks):
@@ -1250,6 +1266,10 @@ async def run_bench(args) -> dict:
         # staged lane rides decoded → inbound → enriched = 3)
         "fastlane": "on" if fastlane_on else "off",
         "hops": 1 if fastlane_on else 3,
+        # egress provenance: fused = scored publishes + alert emission
+        # ride supervised shard loops off the flush path
+        # (kernel/egresslane.py); lanes = consumer loops per group
+        "egress": {"fused": egress_on, "lanes": egress_lanes_live},
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),
         "saturation_trials": trials,
@@ -1399,6 +1419,17 @@ def main() -> None:
                              "ingress fast lane) — the A/B lever for "
                              "measuring the hop fusion; see "
                              "docs/PERFORMANCE.md")
+    parser.add_argument("--no-egress-fusion", action="store_true",
+                        help="pin the legacy inline scored-publish sink "
+                             "(disable the fused egress stage, "
+                             "kernel/egresslane.py) — the A/B lever for "
+                             "measuring the sink-tail fusion")
+    parser.add_argument("--egress-lanes", type=int, default=1,
+                        metavar="N",
+                        help="shard count for the egress stage AND the "
+                             "per-tenant consumer lanes (fast lane, staged "
+                             "inbound, persister, outbound) — N loops per "
+                             "consumer group, splitting partitions")
     parser.add_argument("--force-cpu", action="store_true",
                         help="run on the CPU backend (the supervisor uses "
                              "this when the accelerator is unreachable)")

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Paired A/B bench driver: run two bench.py configurations
+back-to-back on the same host in the same hour — the controlled
+comparison docs/PERFORMANCE.md is built from — write both artifacts,
+and emit the markdown delta table.
+
+Same-day pairing is the whole point: this rig's run-to-run interference
+(BASELINE.md) makes cross-day absolute numbers incomparable, so every
+fusion claim rides an `_on`/`_off` pair produced by ONE invocation of
+this script.
+
+Presets (the levers bench.py exposes):
+
+    egress    on = fused egress stage (`--egress-lanes N`),
+              off = `--no-egress-fusion` (legacy inline sink)
+    fastlane  on = fused ingress lane (auto), off = `--no-fastlane`
+    lanes     a = `--egress-lanes N`, b = `--egress-lanes 1`
+              (sharding delta with fusion on in both runs)
+
+Usage:
+
+    python scripts/ab_compare.py egress --lanes 2 --prefix BENCH_egress \
+        -- --force-cpu --seconds 10 --sat-trials 3
+
+Everything after `--` is passed to BOTH bench runs verbatim. Artifacts
+land at `<prefix>_on.json` / `<prefix>_off.json` (or `_lanes1`/`_lanesN`
+for the lanes preset); the table goes to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def run_bench(extra: list[str], bench_args: list[str], label: str) -> dict:
+    cmd = [sys.executable, BENCH, *bench_args, *extra]
+    print(f"[ab_compare] {label}: {' '.join(cmd)}", file=sys.stderr)
+    proc = subprocess.run(cmd, stdout=subprocess.PIPE, text=True)
+    # the artifact is the last stdout line (supervisor chatter is stderr)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(f"{label}: bench produced no artifact "
+                           f"(exit {proc.returncode})")
+    artifact = json.loads(lines[-1])
+    if proc.returncode != 0 or "error" in artifact:
+        raise RuntimeError(f"{label}: bench failed: "
+                           f"{artifact.get('error', proc.returncode)}")
+    return artifact
+
+
+def stage(artifact: dict, name: str) -> dict:
+    return artifact.get("p99_breakdown", {}).get(name, {})
+
+
+def fmt_stage(artifact: dict, name: str) -> str:
+    s = stage(artifact, name)
+    if not s:
+        return "—"
+    return (f"{s.get('p50_ms', 0):.2f} / {s.get('p95_ms', 0):.2f} / "
+            f"{s.get('p99_ms', 0):.2f}")
+
+
+def ratio(a: float, b: float) -> str:
+    if not b:
+        return "—"
+    r = a / b
+    return f"{r - 1:+.0%}" if 0.1 < r < 10 else f"{r:.2f}×"
+
+
+def delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
+    """Markdown table, columns = [metric, B, A, delta] — B is the
+    baseline (off/lanes=1), A the candidate, matching PERFORMANCE.md's
+    off-then-on column order."""
+    rows = [
+        ("saturation `value_median` (ev/s)",
+         f"{b['value_median']:,.0f}", f"{a['value_median']:,.0f}",
+         ratio(a["value_median"], b["value_median"])),
+        ("saturation best (ev/s)",
+         f"{b['value']:,.0f}", f"{a['value']:,.0f}",
+         ratio(a["value"], b["value"])),
+        ("e2e paced p50 / p99 ms",
+         f"{b['p50_ms']:.2f} / {b['p99_ms']:.2f}",
+         f"{a['p50_ms']:.2f} / {a['p99_ms']:.2f}",
+         ratio(a["p99_ms"], b["p99_ms"])),
+        ("`pipeline_owned_p99_ms`",
+         f"{b['pipeline_owned_p99_ms']:.2f}",
+         f"{a['pipeline_owned_p99_ms']:.2f}",
+         ratio(a["pipeline_owned_p99_ms"], b["pipeline_owned_p99_ms"])),
+    ]
+    for st in ("admit", "batch", "sink"):
+        pa, pb = stage(a, st), stage(b, st)
+        rows.append((f"{st} p50 / p95 / p99 ms",
+                     fmt_stage(b, st), fmt_stage(a, st),
+                     ratio(pa.get("p99_ms", 0.0), pb.get("p99_ms", 0.0))
+                     if pa and pb else "—"))
+    rows.append(("scored-path bus hops",
+                 str(b.get("hops", "—")), str(a.get("hops", "—")), ""))
+    eg_a, eg_b = a.get("egress", {}), b.get("egress", {})
+    rows.append(("egress fused / lanes",
+                 f"{eg_b.get('fused')} / {eg_b.get('lanes')}",
+                 f"{eg_a.get('fused')} / {eg_a.get('lanes')}", ""))
+    out = [f"| metric | {name_b} | {name_a} | Δ (A vs B) |",
+           "|---|---|---|---|"]
+    out += [f"| {m} | {vb} | {va} | {d} |" for m, vb, va, d in rows]
+    return "\n".join(out)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("preset", choices=["egress", "fastlane", "lanes"])
+    parser.add_argument("--lanes", type=int, default=2,
+                        help="egress/consumer lane count for the sharded "
+                             "run (egress + lanes presets)")
+    parser.add_argument("--prefix", default=None,
+                        help="artifact path prefix (default BENCH_<preset>)")
+    argv = sys.argv[1:]
+    bench_args: list[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, bench_args = argv[:split], argv[split + 1:]
+    args = parser.parse_args(argv)
+    args.bench_args = bench_args
+    prefix = args.prefix or f"BENCH_{args.preset}"
+
+    if args.preset == "egress":
+        pairs = [("off", ["--no-egress-fusion"]),
+                 ("on", ["--egress-lanes", str(args.lanes)])]
+        names = ("egress off", f"egress on (lanes={args.lanes})")
+    elif args.preset == "fastlane":
+        pairs = [("off", ["--no-fastlane"]), ("on", [])]
+        names = ("fastlane off", "fastlane on")
+    else:  # lanes: fusion on in both, shard count is the variable
+        pairs = [("lanes1", ["--egress-lanes", "1"]),
+                 (f"lanes{args.lanes}", ["--egress-lanes",
+                                         str(args.lanes)])]
+        names = ("lanes=1", f"lanes={args.lanes}")
+
+    artifacts = []
+    for tag, extra in pairs:
+        artifact = run_bench(extra, args.bench_args, f"{prefix}_{tag}")
+        path = f"{prefix}_{tag}.json"
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        print(f"[ab_compare] wrote {path}", file=sys.stderr)
+        artifacts.append(artifact)
+
+    b, a = artifacts  # baseline ran first (off / lanes1)
+    print(delta_table(names[1], a, names[0], b))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

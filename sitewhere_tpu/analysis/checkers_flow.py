@@ -43,11 +43,23 @@ INGRESS_MODULES = frozenset({
     "sitewhere_tpu/kernel/fastlane.py",
 })
 
+# egress drain modules: the fused egress shard (kernel/egresslane.py)
+# consumes from an in-memory queue instead of a bus poll, but the
+# stakes are identical — one poison scored batch would kill the shard
+# loop (then its restart budget). Modules listed here get their
+# queue-drain `while` loops (a `.popleft()`/`.pop()` dequeue feeding
+# per-record handling) held to the same DLQ01 quarantine contract as
+# bus poll loops.
+DRAIN_MODULES = frozenset({
+    "sitewhere_tpu/kernel/egresslane.py",
+})
+
 _PUBLISH_ATTRS = {"produce", "process_payload"}
 _CONSULT_ATTRS = {"admit_ingress", "charge_produced", "admit_fair",
                   "_charge_quota", "_admit"}
 _QUARANTINE_ATTRS = {"dead_letter", "quarantine"}
 _POLL_ATTRS = {"poll", "poll_nowait"}
+_POP_ATTRS = {"popleft", "pop"}
 
 
 def _attr_calls(node: ast.AST) -> Iterable[ast.Call]:
@@ -154,10 +166,44 @@ def _target_names(target: ast.expr) -> set[str]:
     return {sub.id for sub in ast.walk(target) if isinstance(sub, ast.Name)}
 
 
+def _drains_queue(loop: ast.While) -> bool:
+    """Does the loop's direct body pop records off a queue?"""
+    for stmt in loop.body:
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            if isinstance(value, ast.Call) \
+                    and isinstance(value.func, ast.Attribute) \
+                    and value.func.attr in _POP_ATTRS:
+                return True
+    return False
+
+
 def check_dlq_quarantine(module: Module, project: Project) -> Iterable[Finding]:
     for fn in ast.walk(module.tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
+        if module.relpath in DRAIN_MODULES:
+            # queue-drain while-loops: wrapper existence only — the
+            # pop itself (own deque) can't raise on a poison record,
+            # and statements after the try run post-publish, i.e.
+            # after the batch proved processable
+            for node in _own_body(fn):
+                if not isinstance(node, ast.While) or not _drains_queue(node):
+                    continue
+                protected = any(
+                    isinstance(inner, ast.Try) and _is_protecting(inner)
+                    for sub in node.body for inner in ast.walk(sub))
+                if not protected:
+                    yield Finding(
+                        path=module.relpath, line=node.lineno, code="DLQ01",
+                        message="queue drain loop handles records without "
+                                "the DLQ quarantine wrapper — one poison "
+                                "batch kills this egress shard (then its "
+                                "restart budget)",
+                        hint="wrap per-batch handling in try/except "
+                             "Exception routing to `engine.dead_letter("
+                             "record, exc, self.path)`",
+                        qualname=module.qualname_at(node.lineno))
         poll_names = _poll_names(fn)
         for node in _own_body(fn):
             if not isinstance(node, ast.For) \

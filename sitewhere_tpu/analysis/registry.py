@@ -28,6 +28,7 @@ FAULT_SITES = frozenset({
     "bus.poll",           # kernel/bus.py Consumer.poll_nowait
     "inbound.handle",     # services/inbound_processing.py per-record handle
     "fastlane.handle",    # kernel/fastlane.py fused per-record handle
+    "egress.publish",     # kernel/egresslane.py per-batch scored publish
     "durable.flush",      # persistence/durable.py spill writer
     "scoring.dispatch",   # scoring/server.py flush paths
     "flow.admit",         # kernel/flow.py ingress admission
@@ -50,6 +51,9 @@ COUNTERS = (
     "inbound.events_unregistered",
     "fastlane.events_unregistered",
     "fastlane.records_lost",
+    "egress.publish_failures",
+    "egress.alert_failures",
+    "rules.alerts_emitted",
     "batch.elements_processed",
     "event_sources.decode_failures",
     "event_sources.quota_rejected",
@@ -87,6 +91,7 @@ METERS = (
     "scoring.events_scored",
     "inbound.events_processed",
     "fastlane.events_processed",
+    "egress.events_published",
     "event_sources.events_received",
     "event_management.events_persisted",
     "device_state.events_merged",

@@ -77,6 +77,15 @@ class InstanceSettings:
     flow_defer_at: float = 0.9
     flow_hysteresis: float = 0.8
     flow_dlq_rate_max: float = 50.0   # DLQ events/s mapping to pressure 1.0
+    # egress fast lanes (kernel/egresslane.py): `egress_fused` engages
+    # the fused scored-publish stage (settle tasks enqueue, supervised
+    # shard loops publish + emit alerts off the flush path);
+    # `egress_lanes` is the default shard count for the egress stage AND
+    # the per-tenant consumer lanes (fast lane, staged inbound,
+    # persister, outbound fan-out) — N loops join one consumer group,
+    # splitting partitions. Tenant `egress: {fused, lanes}` overrides.
+    egress_fused: bool = True
+    egress_lanes: int = 1
     # log level
     log_level: str = "INFO"
 

@@ -36,6 +36,14 @@ from sitewhere_tpu.kernel.lifecycle import LifecycleComponent, LifecycleProgress
 logger = logging.getLogger(__name__)
 
 
+def key_hash(key: str) -> int:
+    """THE record-key hash: partition selection here and shard routing
+    in kernel/egresslane.py must agree, or the egress stage's per-key
+    publish-order guard stops corresponding to the partition it
+    protects — change it in one place or not at all."""
+    return zlib.crc32(key.encode())
+
+
 @dataclass(frozen=True, slots=True)
 class TopicRecord:
     """One record as seen by a consumer (analog of ConsumerRecord)."""
@@ -172,7 +180,7 @@ class EventBus(LifecycleComponent):
         n = len(topic.partitions)
         if key is None:
             return next(self._rr) % n
-        return zlib.crc32(key.encode()) % n
+        return key_hash(key) % n
 
     async def produce(self, topic_name: str, value: Any, *,
                       key: Optional[str] = None,

@@ -75,6 +75,7 @@ from sitewhere_tpu.domain.batch import (
     RegistrationBatch,
 )
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.egresslane import commit_barrier
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 
 logger = logging.getLogger(__name__)
@@ -159,11 +160,23 @@ async def validate_and_split(batch, dm, runtime, unregistered_topic,  # swxlint:
 
 class FastLane(BackgroundTaskComponent):
     """The fused consumer loop (hosted by a RuleProcessingEngine: it
-    owns the scoring sink the fusion targets)."""
+    owns the scoring sink the fusion targets).
 
-    def __init__(self, engine):
-        super().__init__("fastlane")
+    Sharding (`egress: {lanes: N}`, kernel/egresslane.py): the engine
+    hosts N of these, every shard joining the SAME consumer group — the
+    bus splits the decoded topic's partitions across them, so flood-mode
+    admission scales across loops instead of serializing on one, and a
+    lane-count change (config update → engine respin) resumes each
+    partition from the group's committed offset. All shards share the
+    one `validate_and_split` / `shed_route` / `checkpoint_commit`
+    implementation and the one scoring sink, so shard count can never
+    change behavior — only concurrency (asserted by
+    tests/test_egress.py lane-count equivalence)."""
+
+    def __init__(self, engine, shard: int = 0):
+        super().__init__("fastlane" if shard == 0 else f"fastlane-{shard}")
         self.engine = engine
+        self.shard = shard
         self._inbound_topic = engine.tenant_topic(TopicNaming.INBOUND_EVENTS)
         self._unregistered_topic = engine.tenant_topic(
             TopicNaming.UNREGISTERED_DEVICES)
@@ -203,6 +216,10 @@ class FastLane(BackgroundTaskComponent):
         # most the unsettled tail, which is the staged lanes' combined
         # at-least-once guarantee
         ckpt: Optional[tuple[int, dict]] = None
+        # composes the fused egress stage into the barrier when enabled
+        # (kernel/egresslane.py): offsets wait for the PUBLISH, exactly
+        # like the staged lane's rule processor
+        barrier = commit_barrier(sink, engine.egress)
         cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
         if not cap and engine.pool_slot is not None:
             cap = engine.pool_slot.pool.cfg.backlog_events
@@ -222,9 +239,11 @@ class FastLane(BackgroundTaskComponent):
                         tenant_id, pending=sink.pending_n, cap=cap,
                         inflight=getattr(sink, "inflight", 0),
                         max_inflight=max_inflight)
-                if sink is not None and sink.backlogged:
+                if sink is not None and barrier.backlogged:
                     # backpressure through uncommitted bus offsets, same
-                    # as the slow lane: stop consuming, keep flushing
+                    # as the slow lane: stop consuming, keep flushing.
+                    # The barrier view covers BOTH capacities — scoring
+                    # admission and unpublished egress output.
                     if session is not None and session.flush_due:
                         session.flush_nowait()
                     await asyncio.sleep(
@@ -254,7 +273,7 @@ class FastLane(BackgroundTaskComponent):
                     # Sub-bucket admits gathered above share ONE flush —
                     # the session's batch window does the coalescing.
                     session.flush_nowait()
-                ckpt = await checkpoint_commit(consumer, sink, ckpt)
+                ckpt = await checkpoint_commit(consumer, barrier, ckpt)
         finally:
             consumer.close()
 

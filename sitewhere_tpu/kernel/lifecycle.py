@@ -389,9 +389,21 @@ class BackgroundTaskComponent(LifecycleComponent):
                 pass
             self._restart_task = None
         if self._task is not None:
+            # cancel-until-dead: a single cancel() can be SWALLOWED when
+            # the await the task is parked on completes in the same loop
+            # tick (asyncio.wait_for's cancellation race, bpo-42130 —
+            # observed when a consumer-group peer's close() rebalances
+            # and wakes this loop's poll exactly as stop cancels it).
+            # The loop keeps running and `await task` would hang stop
+            # forever; re-cancel each beat until the task is truly done.
             self._task.cancel()
+            while True:
+                done, _ = await asyncio.wait({self._task}, timeout=1.0)
+                if done:
+                    break
+                self._task.cancel()
             try:
-                await self._task
+                self._task.result()
             except asyncio.CancelledError:
                 pass
             except BaseException:  # noqa: BLE001 - task error surfaces here

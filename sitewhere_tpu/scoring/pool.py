@@ -632,7 +632,10 @@ class SharedScoringPool:
                     self.sink_failures.inc()
                     logger.exception("pool deliver failed for tenant %s", tid)
                 else:
-                    self.stage_sink.observe(time.monotonic() - t_sink)
+                    if not getattr(e.deliver, "owns_sink_stage", False):
+                        # fused egress delivery (kernel/egresslane.py)
+                        # observes settled→PUBLISHED itself
+                        self.stage_sink.observe(time.monotonic() - t_sink)
         finally:
             self.inflight -= 1
             self.settled_count += 1

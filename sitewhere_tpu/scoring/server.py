@@ -566,7 +566,11 @@ class ScoringSession:
                     self.sink_failures.inc()
                     logger.exception("scoring sink failed")
                 else:
-                    self.stage_sink.observe(time.monotonic() - now)
+                    if not getattr(self.sink, "owns_sink_stage", False):
+                        # a fused egress sink (kernel/egresslane.py)
+                        # observes settled→PUBLISHED itself; timing the
+                        # enqueue here would record ~0 and hide the tail
+                        self.stage_sink.observe(time.monotonic() - now)
         finally:
             self.inflight -= 1
             self.settled_count += 1

@@ -25,6 +25,7 @@ from sitewhere_tpu.domain.events import (
     DeviceStateChange,
 )
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.egresslane import egress_lanes
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.persistence.memory import InMemoryDeviceEventManagement
@@ -41,8 +42,16 @@ class EventManagementEngine(TenantEngine):
     def __init__(self, service: "EventManagementService", tenant: TenantConfig):
         super().__init__(service, tenant)
         self.spi: InMemoryDeviceEventManagement = None  # type: ignore[assignment]
-        self.persister = EventPersister(self)
-        self.add_child(self.persister)
+        # `egress: {lanes: N}` (kernel/egresslane.py) shards the persist
+        # consumer: N loops in the one `{tenant}.event-management`
+        # group split the inbound topic's partitions (per-device order
+        # holds — one key, one partition, one lane)
+        self.persisters = [
+            EventPersister(self, shard=i)
+            for i in range(egress_lanes(tenant, self.runtime))]
+        self.persister = self.persisters[0]
+        for p in self.persisters:
+            self.add_child(p)
         self._enriched_topic = self.tenant_topic(TopicNaming.OUTBOUND_ENRICHED)
 
     async def _do_initialize(self, monitor) -> None:
@@ -121,9 +130,11 @@ class EventManagementEngine(TenantEngine):
 class EventPersister(BackgroundTaskComponent):
     """Consume inbound events → persist → republish enriched."""
 
-    def __init__(self, engine: EventManagementEngine):
-        super().__init__("event-persister")
+    def __init__(self, engine: EventManagementEngine, shard: int = 0):
+        super().__init__("event-persister" if shard == 0
+                         else f"event-persister-{shard}")
         self.engine = engine
+        self.shard = shard
 
     async def _run(self) -> None:
         engine = self.engine

@@ -26,6 +26,7 @@ from sitewhere_tpu.domain.batch import (
     RegistrationBatch,
 )
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.egresslane import egress_lanes
 from sitewhere_tpu.kernel.fastlane import fastlane_enabled, validate_and_split
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
@@ -43,16 +44,28 @@ class InboundProcessingEngine(TenantEngine):
         # admit — spinning the staged consumer here too would split
         # partitions with it. Both services evaluate the same predicate
         # from config + topology, so they always agree on the lane.
+        # `egress: {lanes: N}` (kernel/egresslane.py) shards the staged
+        # consumer too: N loops join the one
+        # `{tenant}.inbound-processing` group, splitting partitions —
+        # the same lane machinery (and committed-offset resume) as the
+        # fused fast lane, so the A/B compares like with like.
+        self.processors: list[InboundProcessor] = []
         self.processor: Optional[InboundProcessor] = None
         if not fastlane_enabled(tenant, self.runtime):
-            self.processor = InboundProcessor(self)
-            self.add_child(self.processor)
+            self.processors = [
+                InboundProcessor(self, shard=i)
+                for i in range(egress_lanes(tenant, self.runtime))]
+            self.processor = self.processors[0]
+            for p in self.processors:
+                self.add_child(p)
 
 
 class InboundProcessor(BackgroundTaskComponent):
-    def __init__(self, engine: InboundProcessingEngine):
-        super().__init__("inbound-processor")
+    def __init__(self, engine: InboundProcessingEngine, shard: int = 0):
+        super().__init__("inbound-processor" if shard == 0
+                         else f"inbound-processor-{shard}")
         self.engine = engine
+        self.shard = shard
 
     async def _run(self) -> None:
         engine = self.engine

@@ -45,6 +45,7 @@ from sitewhere_tpu.domain.batch import (
     ScoredBatch,
 )
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.egresslane import egress_lanes
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 
@@ -315,8 +316,22 @@ class OutboundConnectorsEngine(TenantEngine):
             self.connector_scripts.put(name, source)
         for c in cfg.get("connectors", []):
             self.add_connector_config(c)
-        self.manager = OutboundManager(self)
-        self.add_child(self.manager)
+        # `egress: {lanes: N}` (kernel/egresslane.py) shards the fan-out
+        # consumer: N loops in the one `{tenant}.outbound-connectors`
+        # group split the enriched + scored topics' partitions
+        self.managers = [
+            OutboundManager(self, shard=i)
+            for i in range(egress_lanes(tenant, self.runtime))]
+        self.manager = self.managers[0]
+        for m in self.managers:
+            self.add_child(m)
+
+    async def _do_stop(self, monitor) -> None:
+        await super()._do_stop(monitor)
+        # engine-level close (was per-manager): with sharded managers,
+        # exactly ONE owner releases connector resources
+        for connector in self.connectors.values():
+            connector.close()
 
     def put_connector_script(self, name: str, source: str):
         """Upload/hot-reload a connector script (live connectors bound
@@ -412,9 +427,11 @@ class OutboundConnectorsEngine(TenantEngine):
 
 
 class OutboundManager(BackgroundTaskComponent):
-    def __init__(self, engine: OutboundConnectorsEngine):
-        super().__init__("outbound-manager")
+    def __init__(self, engine: OutboundConnectorsEngine, shard: int = 0):
+        super().__init__("outbound-manager" if shard == 0
+                         else f"outbound-manager-{shard}")
         self.engine = engine
+        self.shard = shard
 
     async def _run(self) -> None:
         engine = self.engine
@@ -450,11 +467,6 @@ class OutboundManager(BackgroundTaskComponent):
                 consumer.commit()
         finally:
             consumer.close()
-
-    async def _do_stop(self, monitor) -> None:
-        await super()._do_stop(monitor)
-        for connector in self.engine.connectors.values():
-            connector.close()
 
 
 class OutboundConnectorsService(Service):

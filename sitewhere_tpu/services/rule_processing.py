@@ -45,6 +45,12 @@ import numpy as np
 from sitewhere_tpu.config import TenantConfig
 from sitewhere_tpu.domain.batch import AlertBatch, MeasurementBatch, ScoredBatch
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.egresslane import (
+    EgressStage,
+    commit_barrier,
+    egress_fused,
+    egress_lanes,
+)
 from sitewhere_tpu.kernel.fastlane import (
     FastLane,
     checkpoint_commit,
@@ -123,6 +129,20 @@ class RuleProcessingEngine(TenantEngine):
         self.mesh_spec: Optional[dict] = cfg.get("mesh")
         self.session: Optional[ScoringSession] = None
         self.pool_slot: Optional[TenantSlot] = None
+        # fused egress stage (kernel/egresslane.py): scored publishes +
+        # alert emission run on supervised shard loops off the flush
+        # path; scored_sink is what every scored batch flows through
+        # (the stage when fused, the legacy inline publish otherwise).
+        # Declared FIRST so its shard children stop LAST — they must
+        # outlive the consumer loops to publish the final settles.
+        self.egress: Optional[EgressStage] = None
+        if self.model_name and egress_fused(tenant, self.runtime):
+            self.egress = EgressStage(
+                self, lanes=egress_lanes(tenant, self.runtime))
+            for shard in self.egress.shards:
+                self.add_child(shard)
+        self.scored_sink = (self.egress if self.egress is not None
+                            else self._deliver_scored)
         self.hooks: dict[str, Hook] = {}
         # script manager: uploaded python scripts become hooks (reference:
         # Groovy stream processors synced per tenant, SURVEY.md §2.1)
@@ -143,11 +163,21 @@ class RuleProcessingEngine(TenantEngine):
         # shape permits, this engine ALSO consumes the decoded topic and
         # performs fair-admission + mask validation + scoring admit in
         # one hop; inbound-processing evaluates the same predicate and
-        # skips its staged consumer for this tenant
+        # skips its staged consumer for this tenant. With
+        # `egress: {lanes: N}` the lane is SHARDED: N consumer loops
+        # join the one `{tenant}.inbound-processing` group, splitting
+        # the decoded topic's partitions — flood-mode admission stops
+        # serializing on one loop, and a lane-count change resumes from
+        # the group's committed offsets.
+        self.fastlanes: list[FastLane] = []
         self.fastlane: Optional[FastLane] = None
         if fastlane_enabled(tenant, self.runtime):
-            self.fastlane = FastLane(self)
-            self.add_child(self.fastlane)
+            self.fastlanes = [
+                FastLane(self, shard=i)
+                for i in range(egress_lanes(tenant, self.runtime))]
+            self.fastlane = self.fastlanes[0]
+            for lane in self.fastlanes:
+                self.add_child(lane)
 
     async def _do_initialize(self, monitor) -> None:
         if not self.model_name:
@@ -160,12 +190,12 @@ class RuleProcessingEngine(TenantEngine):
                 self.mesh_spec)
             self.pool_slot = pool.register(
                 self.tenant_id, em.telemetry, self.scoring_cfg.threshold,
-                self._deliver_scored)
+                self.scored_sink)
         else:
             model = build_model(self.model_name, **self.model_config)
             self.session = ScoringSession(
                 model, em.telemetry, self.runtime.metrics, self.scoring_cfg,
-                sink=self._deliver_scored, tracer=self.runtime.tracer,
+                sink=self.scored_sink, tracer=self.runtime.tracer,
                 faults=self.runtime.faults)
 
     async def _do_start(self, monitor) -> None:
@@ -189,6 +219,11 @@ class RuleProcessingEngine(TenantEngine):
             await self.pool_slot.drain(timeout=10.0)
             self.pool_slot.pool.unregister(self.tenant_id)
             self.pool_slot = None
+        if self.egress is not None:
+            # the shard loops (children, stopped just before this) drain
+            # their queues on the way down; this is the belt-and-braces
+            # wait for anything a straggling settle enqueued after
+            await self.egress.drain(timeout=5.0)
 
     async def shed_route(self, batch: MeasurementBatch, sink,
                          key: Optional[str] = None) -> None:
@@ -215,19 +250,27 @@ class RuleProcessingEngine(TenantEngine):
         elif shed == "degrade":
             scored = self.degraded_score(batch)
             flow.count_shed(self.tenant_id, "degrade", len(batch))
-            await self._deliver_scored(scored)
+            await self.scored_sink(scored)
         else:
             sink.admit(batch)
 
     async def _deliver_scored(self, scored: ScoredBatch) -> None:
-        """Pool flush sink: publish scored events + emit anomaly alerts
-        (the dedicated-session path does the same in RuleProcessor)."""
+        """LEGACY inline sink (`egress: {fused: false}`, the A/B
+        baseline): publish scored events + emit anomaly alerts right on
+        the settle path. The fused default routes through the
+        EgressStage instead (kernel/egresslane.py), which publishes and
+        emits alerts on supervised shard loops off the flush path."""
         await self.runtime.bus.produce(
             self.tenant_topic(TopicNaming.SCORED_EVENTS), scored,
             key=scored.ctx.source)
         if self.emit_alerts and scored.is_anomaly.any():
             em = self.runtime.api("event-management").management(self.tenant_id)
             em.add_alert_batch(anomaly_alerts(scored, self.model_name))
+
+    def build_anomaly_alerts(self, scored: ScoredBatch) -> AlertBatch:
+        """The egress stage's alert builder (one place owns the
+        model-name attribution for both the inline and fused sinks)."""
+        return anomaly_alerts(scored, self.model_name)
 
     # -- extension points --------------------------------------------------
 
@@ -380,6 +423,10 @@ class RuleProcessor(BackgroundTaskComponent):
         deferred_consumer = None
         # checkpointed commit state: (dispatch_count at snapshot, positions)
         ckpt: Optional[tuple[int, dict]] = None
+        # the commit barrier composes the scoring sink with the fused
+        # egress stage (kernel/egresslane.py): offsets commit only once
+        # settles have PUBLISHED, not merely settled
+        barrier = commit_barrier(sink, engine.egress)
         cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
         if not cap and engine.pool_slot is not None:
             cap = engine.pool_slot.pool.cfg.backlog_events
@@ -397,8 +444,9 @@ class RuleProcessor(BackgroundTaskComponent):
         try:
             while True:
                 mode = report()
-                if sink is not None and sink.backlogged:
-                    # backpressure: the scorer's admission backlog is at
+                if sink is not None and barrier.backlogged:
+                    # backpressure: the scorer's admission backlog — or
+                    # the egress stage's unpublished output — is at
                     # capacity (warmup compile, regrow, overload). Stop
                     # consuming — records stay in the bus uncommitted
                     # (at-least-once within the retention window; past it
@@ -458,7 +506,7 @@ class RuleProcessor(BackgroundTaskComponent):
                 # the same iteration (found by the forced-defer test)
                 mode = report()
                 if (mode == "ok" and flow is not None and sink is not None
-                        and not sink.backlogged
+                        and not barrier.backlogged
                         and hasattr(runtime.bus, "peek")):
                     # overload cleared: drain a bounded slice of the
                     # deferred spool back through the scorer. Bounded per
@@ -489,7 +537,7 @@ class RuleProcessor(BackgroundTaskComponent):
                 # one implementation for both lanes) commits snapshots
                 # once everything dispatched before them has settled
                 # AND published. A crash redelivers the unsettled tail.
-                ckpt = await checkpoint_commit(consumer, sink, ckpt)
+                ckpt = await checkpoint_commit(consumer, barrier, ckpt)
         finally:
             if deferred_consumer is not None:
                 deferred_consumer.close()

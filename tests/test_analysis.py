@@ -230,6 +230,44 @@ def test_dlq01_record_touched_outside_wrapper():
     assert "outside" in rep.findings[0].message
 
 
+_DRAIN_LOOP = """
+    class Shard:
+        async def _run(self):
+            while True:
+                await self.wake.wait()
+                while self.queue:
+                    scored, t = self.queue.popleft()
+                    {body}
+"""
+EGRESS = "sitewhere_tpu/kernel/egresslane.py"  # DRAIN_MODULES member
+
+
+def test_dlq01_drain_loop_without_wrapper():
+    # the egress shard's in-memory queue drain is held to the same
+    # quarantine contract as a bus poll loop
+    rep = _lint(_DRAIN_LOOP.format(body="await self.publish(scored)"),
+                path=EGRESS)
+    assert _codes(rep) == ["DLQ01"]
+    assert "drain" in rep.findings[0].message
+
+
+def test_dlq01_drain_loop_quarantined_is_clean():
+    rep = _lint(_DRAIN_LOOP.format(body="""try:
+                        await self.publish(scored)
+                    except Exception as exc:
+                        await self.engine.dead_letter(scored, exc, self.path)"""),
+                path=EGRESS)
+    assert _codes(rep) == []
+
+
+def test_dlq01_drain_rule_scoped_to_drain_modules():
+    # the DRR scheduler (kernel/flow.py) pops admission lanes — not a
+    # record drain; the rule only applies to DRAIN_MODULES
+    rep = _lint(_DRAIN_LOOP.format(body="await self.publish(scored)"),
+                path="sitewhere_tpu/kernel/flow.py")
+    assert _codes(rep) == []
+
+
 def test_dlq01_suppressed_on_for_line():
     rep = _lint("""
         class Manager:

@@ -131,12 +131,21 @@ async def _drive_and_collect(rt, n_sim=48, ticks=6):
     em = rt.api("event-management").management("acme")
     await wait_until(lambda: em.telemetry.total_events >= expected,
                      timeout=30.0)
+    # collect off the TOPIC, waiting on published records: with the
+    # fused egress stage (kernel/egresslane.py) a settled flush is
+    # published a beat later by the shard loop, so settle count alone
+    # no longer implies the records are poll-able
     scored = {}
-    for r in consumer.poll_nowait(max_records=512):
-        b = r.value
-        for i in range(len(b)):
-            scored[(int(b.device_index[i]), float(b.ts[i]))] = (
-                round(float(b.score[i]), 3), bool(b.is_anomaly[i]))
+
+    def collect():
+        for r in consumer.poll_nowait(max_records=512):
+            b = r.value
+            for i in range(len(b)):
+                scored[(int(b.device_index[i]), float(b.ts[i]))] = (
+                    round(float(b.score[i]), 3), bool(b.is_anomaly[i]))
+        return len(scored) >= expected
+
+    await wait_until(collect, timeout=30.0)
     consumer.close()
     unreg_topic = rt.naming.tenant_topic(
         "acme", TopicNaming.UNREGISTERED_DEVICES)
