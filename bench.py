@@ -966,7 +966,15 @@ async def run_bench(args) -> dict:
         os.makedirs(args.durable, exist_ok=True)
     rt = ServiceRuntime(InstanceSettings(
         instance_id="bench", engine_ready_timeout_s=args.ready_timeout,
-        data_dir=args.durable))
+        data_dir=args.durable,
+        # the saturation phase floods an unbounded open loop, so the
+        # overload controller's reject-at-ingress is the correct (and
+        # measured: `scoring.ingress_rejected`) shed; degrade/defer
+        # would divert ACCEPTED events around the scorer under test and
+        # break the drain accounting (lat_hist counts scorer settles).
+        # Both A/B legs get the same policy; `--overload` is the bench
+        # that exercises the full shed ladder.
+        flow_degrade_at=10.0, flow_defer_at=10.0))
     fi = None
     if args.chaos:
         # chaos mode: deterministic fault injection at three layers —
@@ -989,11 +997,13 @@ async def run_bench(args) -> dict:
         rt.add_service(cls(rt))
     await rt.start()
     # --pooled T = config 4: T tenants sharing one stacked-params scorer
-    # (one vmapped XLA call per flush scores every tenant); otherwise one
-    # tenant with a dedicated session
+    # (one vmapped XLA call per flush scores every tenant); --tenants N
+    # is the megabatch A/B's tenant-count axis (dedicated sessions when
+    # --no-megabatch, one megabatched pool otherwise)
     pooled = args.pooled > 1
-    tenant_ids = ([f"bench{i}" for i in range(args.pooled)] if pooled
-                  else ["bench"])
+    n_tenants = max(args.pooled, args.tenants, 1)
+    tenant_ids = ([f"bench{i}" for i in range(n_tenants)]
+                  if n_tenants > 1 else ["bench"])
     per_tenant = max(args.devices // len(tenant_ids), 1)
     # --no-fastlane pins the staged slow lane via the tenant override the
     # fused ingress fast lane honors (kernel/fastlane.py) — the A/B lever
@@ -1026,6 +1036,10 @@ async def run_bench(args) -> dict:
                 "max_inflight": args.max_inflight,
                 "readback": args.readback,
                 "shared": pooled,
+                # --megabatch/--no-megabatch: the cross-tenant stacked
+                # dispatch lever (scoring/pool.py) — ONE jit call per
+                # flush round for every tenant vs one per tenant
+                "megabatch": {"enabled": args.megabatch},
             },
         }))
     sims, receivers, sinks = [], [], []
@@ -1048,6 +1062,18 @@ async def run_bench(args) -> dict:
                          .receiver("default"))
         eng = rt.api("rule-processing").engine(tid)
         sinks.append(eng.session or eng.pool_slot)
+    # megabatch provenance from the live engines (the engaged path, not
+    # the flag): every tenant riding the shared stacked-dispatch pool
+    engines = [rt.api("rule-processing").engine(tid) for tid in tenant_ids]
+    megabatch_on = all(e.megabatch and e.pool_slot is not None
+                       for e in engines)
+    eff_window_ms = (engines[0].pool_slot.pool.cfg.window_s * 1e3
+                     if engines[0].pool_slot is not None
+                     else args.window_ms)
+    # instance-wide flush-path jit dispatch counter (sessions AND pools
+    # inc the same registry counter): per-trial deltas make the
+    # dispatch-rate collapse measurable in the artifact
+    disp_counter = rt.metrics.counter("scoring.dispatches")
     # lane actually engaged (derived from the live engines, not the
     # flag: auto-detection may decline — e.g. scripts in config)
     fastlane_on = all(
@@ -1120,13 +1146,18 @@ async def run_bench(args) -> dict:
                 elif time.monotonic() - idle_since > 1.0:
                     break
         lat_hist.reset()
+        d0 = disp_counter.value
         t0 = time.monotonic()
         sent = 0
         while time.monotonic() - t0 < args.seconds:
             for sim, receiver in zip(sims, receivers):
                 payload, _ = sim.payload(t=t_base + 10 + 0.001 * k)
-                await receiver.submit(payload)
-                sent += per_tenant
+                # count only ACCEPTED events: an overload-rejected
+                # payload never enters the pipeline, and waiting for it
+                # in the drain would time the trial out on events that
+                # don't exist
+                if await receiver.submit(payload):
+                    sent += per_tenant
             k += 1
         # drain: wait until every sent event is scored and settled
         t_drain = time.monotonic()
@@ -1137,10 +1168,13 @@ async def run_bench(args) -> dict:
         drain_s = time.monotonic() - t_drain
         drain_ok = lat_hist.count >= sent and inflight_total() == 0
         t_elapsed = time.monotonic() - t0
+        n_disp = int(disp_counter.value - d0)
         trials.append({
             "rate": round(lat_hist.count / t_elapsed, 1) if t_elapsed else 0.0,
             "events_scored": int(lat_hist.count),
             "seconds": round(t_elapsed, 2),
+            "dispatches": n_disp,
+            "dispatch_rate": round(n_disp / t_elapsed, 1) if t_elapsed else 0.0,
             "drain_complete": drain_ok,
             "drain_seconds": round(drain_s, 2),
         })
@@ -1177,8 +1211,8 @@ async def run_bench(args) -> dict:
     while time.monotonic() - t1 < args.latency_seconds:
         for sim, receiver in zip(sims, receivers):
             payload, _ = sim.payload(t=t_base + 10_000 + 0.001 * paced_sent)
-            await receiver.submit(payload)
-            paced_sent += per_tenant
+            if await receiver.submit(payload):
+                paced_sent += per_tenant
         next_t += interval
         delay = next_t - time.monotonic()
         if delay > 0:
@@ -1219,6 +1253,11 @@ async def run_bench(args) -> dict:
     kind_l = device_kind.lower()
     peak = next((v for k_, v in PEAK_BF16_FLOPS if k_ in kind_l), None)
     mfu = (model_flops_s / (peak * n_chips)) if peak else None
+    # median-based twin of model_tflops: `rate` is best-of-N (tunnel/
+    # rig variance), so tflops inherits that optimism — the median
+    # column is the honest center for cross-leg/round comparison,
+    # exactly like value_median vs value
+    model_tflops_median = rate_median * flops_ev / 1e12
 
     # spill fidelity: a --durable number is only comparable to the
     # RAM-only number if nothing was dropped; record the counters
@@ -1270,6 +1309,28 @@ async def run_bench(args) -> dict:
         # ride supervised shard loops off the flush path
         # (kernel/egresslane.py); lanes = consumer loops per group
         "egress": {"fused": egress_on, "lanes": egress_lanes_live},
+        # megabatch provenance + the dispatch-rate collapse (the A/B's
+        # acceptance number): dispatches/dispatch_rate are the best
+        # saturation trial's flush-path jit dispatch count/rate —
+        # sessions and the pool inc the same counter, so on/off legs
+        # compare directly
+        "scoring": {
+            "megabatch": megabatch_on,
+            "window_ms": round(eff_window_ms, 3),
+            "dispatches": best["dispatches"],
+            "dispatch_rate": best["dispatch_rate"],
+            "events_per_dispatch": (round(scored / best["dispatches"], 1)
+                                    if best["dispatches"] else 0.0),
+            "tenants_per_dispatch_p50": round(rt.metrics.histogram(
+                "scoring.megabatch_tenants_per_dispatch").quantile(0.5), 1),
+            "stack_rebuilds": int(rt.metrics.counter(
+                "scoring.stack_rebuilds").value),
+            # flood-mode ingress shed (events the open loop offered past
+            # what the pipeline absorbed; NOT counted in `sent`)
+            "ingress_rejected": int(rt.metrics.counter(
+                "flow.rejected").value),
+            "model": args.model,
+        },
         "events_scored": int(scored),
         "seconds": round(elapsed, 2),
         "saturation_trials": trials,
@@ -1282,6 +1343,7 @@ async def run_bench(args) -> dict:
         "tenants": len(tenant_ids),
         "model_flops_per_event": flops_ev,
         "model_tflops": round(model_flops_s / 1e12, 3),
+        "model_tflops_median": round(model_tflops_median, 4),
         "mfu": round(mfu, 5) if mfu is not None else None,
         "fleet_devices": args.devices,
         # EFFECTIVE mode, not the flag: window-ring models fall back to
@@ -1337,6 +1399,23 @@ def main() -> None:
     parser.add_argument("--pooled", type=int, default=1, metavar="T",
                         help="config-4 mode: T tenants share one stacked "
                              "scoring pool (one vmapped call per flush)")
+    parser.add_argument("--tenants", type=int, default=1, metavar="N",
+                        help="active tenant count (fleet split N ways): "
+                             "the megabatch A/B's tenant axis — dedicated "
+                             "per-tenant sessions with --no-megabatch, one "
+                             "cross-tenant stacked dispatch per flush "
+                             "round otherwise")
+    parser.add_argument("--megabatch", dest="megabatch",
+                        action="store_true", default=True,
+                        help="score through the cross-tenant megabatch "
+                             "pool (scoring/pool.py): stacked per-tenant "
+                             "weights, ONE jit dispatch per flush round "
+                             "for every tenant (default on)")
+    parser.add_argument("--no-megabatch", dest="megabatch",
+                        action="store_false",
+                        help="pin dedicated per-tenant sessions (one jit "
+                             "dispatch per tenant per flush round) — the "
+                             "megabatch A/B lever")
     parser.add_argument("--max-inflight", type=int, default=8,
                         help="dispatched-not-settled flush bound; small "
                              "values cap XLA queue depth (tail latency), "

@@ -16,6 +16,10 @@ Presets (the levers bench.py exposes):
     fastlane  on = fused ingress lane (auto), off = `--no-fastlane`
     lanes     a = `--egress-lanes N`, b = `--egress-lanes 1`
               (sharding delta with fusion on in both runs)
+    megabatch on = cross-tenant stacked dispatch (`--tenants N`,
+              one jit call per flush round for the fleet), off =
+              `--no-megabatch --tenants N` (one dispatch per tenant
+              per round) — the dispatch-rate-collapse A/B
 
 Usage:
 
@@ -106,6 +110,30 @@ def delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
     rows.append(("egress fused / lanes",
                  f"{eg_b.get('fused')} / {eg_b.get('lanes')}",
                  f"{eg_a.get('fused')} / {eg_a.get('lanes')}", ""))
+    sc_a, sc_b = a.get("scoring", {}), b.get("scoring", {})
+    if sc_a and sc_b:
+        rows.append(("jit dispatch rate (dispatch/s)",
+                     f"{sc_b.get('dispatch_rate', 0):,.1f}",
+                     f"{sc_a.get('dispatch_rate', 0):,.1f}",
+                     ratio(sc_a.get("dispatch_rate", 0.0),
+                           sc_b.get("dispatch_rate", 0.0))))
+        rows.append(("events per jit dispatch",
+                     f"{sc_b.get('events_per_dispatch', 0):,.1f}",
+                     f"{sc_a.get('events_per_dispatch', 0):,.1f}",
+                     ratio(sc_a.get("events_per_dispatch", 0.0),
+                           sc_b.get("events_per_dispatch", 0.0))))
+        rows.append(("megabatch / tenants-per-dispatch p50",
+                     f"{sc_b.get('megabatch')} / "
+                     f"{sc_b.get('tenants_per_dispatch_p50')}",
+                     f"{sc_a.get('megabatch')} / "
+                     f"{sc_a.get('tenants_per_dispatch_p50')}", ""))
+    rows.append(("model_tflops (best / median)",
+                 f"{b.get('model_tflops', 0)} / "
+                 f"{b.get('model_tflops_median', 0)}",
+                 f"{a.get('model_tflops', 0)} / "
+                 f"{a.get('model_tflops_median', 0)}",
+                 ratio(a.get("model_tflops_median", 0.0) or 0.0,
+                       b.get("model_tflops_median", 0.0) or 0.0)))
     out = [f"| metric | {name_b} | {name_a} | Δ (A vs B) |",
            "|---|---|---|---|"]
     out += [f"| {m} | {vb} | {va} | {d} |" for m, vb, va, d in rows]
@@ -115,10 +143,15 @@ def delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
 def main() -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
-    parser.add_argument("preset", choices=["egress", "fastlane", "lanes"])
+    parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
+                                           "megabatch"])
     parser.add_argument("--lanes", type=int, default=2,
                         help="egress/consumer lane count for the sharded "
                              "run (egress + lanes presets)")
+    parser.add_argument("--tenants", type=int, default=8,
+                        help="active tenant count for the megabatch "
+                             "preset (both legs; acceptance wants ≥4 — "
+                             "the dispatch-rate reduction scales with it)")
     parser.add_argument("--prefix", default=None,
                         help="artifact path prefix (default BENCH_<preset>)")
     argv = sys.argv[1:]
@@ -137,6 +170,12 @@ def main() -> int:
     elif args.preset == "fastlane":
         pairs = [("off", ["--no-fastlane"]), ("on", [])]
         names = ("fastlane off", "fastlane on")
+    elif args.preset == "megabatch":
+        t = str(args.tenants)
+        pairs = [("off", ["--no-megabatch", "--tenants", t]),
+                 ("on", ["--tenants", t])]
+        names = (f"megabatch off ({t} tenants)",
+                 f"megabatch on ({t} tenants)")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",

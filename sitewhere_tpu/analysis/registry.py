@@ -31,6 +31,7 @@ FAULT_SITES = frozenset({
     "egress.publish",     # kernel/egresslane.py per-batch scored publish
     "durable.flush",      # persistence/durable.py spill writer
     "scoring.dispatch",   # scoring/server.py flush paths
+    "scoring.megabatch",  # scoring/pool.py megabatch admission
     "flow.admit",         # kernel/flow.py ingress admission
     "flow.shed",          # kernel/flow.py shed-mode consult
 })
@@ -47,6 +48,9 @@ COUNTERS = (
     "scoring.admissions_dropped",
     "scoring.sink_failures",
     "scoring.bus_records_lost",
+    "scoring.dispatches",
+    "scoring.megabatch_dispatches",
+    "scoring.stack_rebuilds",
     # pipeline services
     "inbound.events_unregistered",
     "fastlane.events_unregistered",
@@ -106,6 +110,7 @@ HISTOGRAMS = (
     "scoring.stage_batch_s",
     "scoring.stage_device_s",
     "scoring.stage_sink_s",
+    "scoring.megabatch_tenants_per_dispatch",
 )
 
 # f-string metric names whose suffix is computed at runtime

@@ -38,6 +38,20 @@ class InstanceSettings:
     trace_sample: int = 64     # record spans for every Nth trace [SURVEY §5.1]
     scoring_batch_window_ms: float = 2.0
     scoring_batch_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
+    # cross-tenant megabatched scoring (scoring/pool.py): when enabled,
+    # every tenant of one model architecture scores through the shared
+    # stacked-params pool — ONE jit dispatch per flush round for the
+    # whole fleet instead of one per tenant. `window_ms` is the
+    # megabatch close deadline (the ≤1 ms latency traded for the
+    # dispatch-rate collapse); `max_tenants` bounds tenants packed into
+    # one stacked dispatch (0 = every due tenant). Tenant
+    # `rule-processing: {megabatch: {enabled, window_ms, max_tenants}}`
+    # overrides. Off by default: single-tenant instances keep the
+    # dedicated per-tenant session (own compiled buckets, own cadence);
+    # enable it wherever many tenants share an architecture.
+    scoring_megabatch: bool = False
+    scoring_megabatch_window_ms: float = 1.0
+    scoring_megabatch_max_tenants: int = 0
     # engine spin-up bound: first TPU compiles over a tunneled chip can
     # take minutes — the old 60 s default killed whole bench runs
     engine_ready_timeout_s: float = 300.0

@@ -35,6 +35,13 @@ separation of scoring from delivery):
   its scored output left the stage (published, or quarantined with
   provenance — never silently dropped).
 
+Cross-tenant megabatched scoring (scoring/pool.py) fans ONE settled
+stacked dispatch back out as N per-tenant `ScoredBatch`es, each entering
+its own tenant's EgressStage through the shared `deliver_scored`
+contract below — so the dispatch-rate collapse upstream never changes
+what egress observes: per-tenant stages, per-tenant DLQs, per-tenant
+commit barriers, exactly as if each tenant had flushed alone.
+
 A publish failure dead-letters the scored batch to the tenant DLQ with
 egress provenance (`kernel/dlq.py` replay re-publishes it onto the
 scored topic); an alert-emission failure after a successful publish is
@@ -82,6 +89,35 @@ from sitewhere_tpu.kernel.bus import (
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 
 logger = logging.getLogger(__name__)
+
+
+async def deliver_scored(sink, scored, sink_failures, stage_sink,
+                         label: str = "") -> None:
+    """One settled `ScoredBatch` into a scoring sink, under the ONE
+    delivery contract every settle path shares (the dedicated session's
+    per-flush settle AND the pool's per-tenant megabatch fan-out):
+
+    - a sink failure is counted (`scoring.sink_failures`) and isolated —
+      it can never kill the settle task or, in a megabatch, another
+      tenant's delivery;
+    - `scoring.stage_sink_s` (settled → published) is observed here only
+      for sinks that don't own the stage themselves (`owns_sink_stage`:
+      the fused EgressStage observes submit → PUBLISHED on its shard
+      loops, and timing the enqueue would record ~0 and hide the tail).
+
+    The pool gathers one of these per tenant of a settled megabatch, so
+    a slow legacy-inline sink for one tenant never serializes the other
+    tenants' deliveries behind it."""
+    t_sink = time.monotonic()
+    try:
+        await sink(scored)
+    except Exception:  # noqa: BLE001 - sink errors can't kill settles
+        sink_failures.inc()
+        logger.exception("scoring sink failed%s",
+                         f" for {label}" if label else "")
+    else:
+        if not getattr(sink, "owns_sink_stage", False):
+            stage_sink.observe(time.monotonic() - t_sink)
 
 
 def egress_fused(tenant, runtime) -> bool:

@@ -194,8 +194,9 @@ class FastLane(BackgroundTaskComponent):
         # engines start in broadcast order across services — wait, don't race
         dm = await runtime.wait_for_engine("device-management", tenant_id)
         dm_service = runtime.services.get("device-management")
-        # sink: dedicated session or the shared pool's tenant slot (the
-        # pool flushes itself; slot.flush_due is constant-False)
+        # sink: dedicated session or the shared pool's tenant slot —
+        # slots delegate flush_due/flush_nowait to the POOL, so this
+        # lane's turns drive the shared megabatch rounds too
         sink = engine.session or engine.pool_slot
         session = engine.session
         decoded_topic = engine.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED)
@@ -223,6 +224,11 @@ class FastLane(BackgroundTaskComponent):
         cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
         if not cap and engine.pool_slot is not None:
             cap = engine.pool_slot.pool.cfg.backlog_events
+        # pool slots report max_inflight=0 on purpose (see the staged
+        # rule processor): a megabatched tenant's inflight share pegs at
+        # the POOL cap under healthy pipelining, and reading that as
+        # per-tenant pressure shed floods the scorer was absorbing —
+        # the slot's backlog (pending vs cap) is its overload signal
         max_inflight = getattr(getattr(session, "cfg", None),
                                "max_inflight", 0)
         try:
@@ -244,8 +250,8 @@ class FastLane(BackgroundTaskComponent):
                     # as the slow lane: stop consuming, keep flushing.
                     # The barrier view covers BOTH capacities — scoring
                     # admission and unpublished egress output.
-                    if session is not None and session.flush_due:
-                        session.flush_nowait()
+                    if sink.flush_due:
+                        sink.flush_nowait()
                     await asyncio.sleep(
                         max(sink.flush_wait_s, 0.001) if sink.ready else 0.05)
                     continue
@@ -267,12 +273,14 @@ class FastLane(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
-                if session is not None and session.flush_due:
+                if sink is not None and sink.flush_due:
                     # pipelined: dispatch now; settle/publish runs via the
-                    # session sink without blocking this consumer loop.
+                    # scored sink without blocking this consumer loop.
                     # Sub-bucket admits gathered above share ONE flush —
-                    # the session's batch window does the coalescing.
-                    session.flush_nowait()
+                    # the batch window does the coalescing. Pool slots
+                    # delegate to the shared megabatch round, so consumer
+                    # turns drive the stacked dispatch cadence too.
+                    sink.flush_nowait()
                 ckpt = await checkpoint_commit(consumer, barrier, ckpt)
         finally:
             consumer.close()

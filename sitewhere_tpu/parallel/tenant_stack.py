@@ -60,6 +60,19 @@ class TenantStack:
         self._free: list[int] = []
         self.capacity = 0
         self.stacked = None           # pytree, leaves [T_cap, ...]
+        # stack-mutation counter: bumped on EVERY mutation (param swap,
+        # tenant add/remove, growth) — the observable the fence tests
+        # pin. The torn-stack SAFETY itself comes from two mechanisms
+        # that need no runtime check: the dispatched jit holds its own
+        # reference to the stacked pytree it read (mutations replace,
+        # never modify), and SharedScoringPool._flush_round snapshots
+        # per-tenant versions at dispatch so settle attribution can't
+        # drift to fresher weights.
+        self.fence = 0
+        # capacity growths (each one invalidates compiled buckets and
+        # forces a recompile round) — the pool surfaces this as the
+        # `scoring.stack_rebuilds` counter
+        self.rebuilds = 0
         self._fns: dict[tuple[int, int], Callable] = {}
         self._init_params = model.init(jax.random.PRNGKey(seed))
 
@@ -103,6 +116,8 @@ class TenantStack:
                 lambda t, o: t.at[:old_cap].set(o), tiled, old)
         self.stacked = self._place_stack(tiled)
         self.capacity = cap
+        self.fence += 1
+        self.rebuilds += 1
         self._fns.clear()  # shapes changed; recompile lazily per bucket
 
     def add_tenant(self, tenant_id: str, params: Optional[dict] = None) -> int:
@@ -127,6 +142,20 @@ class TenantStack:
         self.versions.pop(tenant_id, None)
         if slot is not None:
             self._free.append(slot)
+            self.fence += 1
+
+    def occupancy(self) -> np.ndarray:
+        """[capacity] bool mask of occupied slots — an introspection
+        surface (lifecycle tests, diagnostics), the host-side truth of
+        which rows carry a live tenant. The production ragged masking
+        lives in the stacked rings' scratch-row padding
+        (scoring/ring.py, scoring/stream.py): free slots there score
+        garbage nobody reads, by design."""
+        occ = np.zeros(self.capacity, bool)
+        for slot in self.slots.values():
+            if slot < occ.shape[0]:
+                occ[slot] = True
+        return occ
 
     def set_params(self, tenant_id: str, params: dict, *, _bump: bool = True) -> int:
         """Hot-swap one tenant's slice (checkpoint rollout): a device-side
@@ -136,6 +165,7 @@ class TenantStack:
             lambda s, p: s.at[slot].set(p.astype(s.dtype)), self.stacked, params)
         if self.mesh is not None:  # keep the shard placement committed
             self.stacked = self._place_stack(self.stacked)
+        self.fence += 1
         if _bump:
             self.versions[tenant_id] += 1
         return self.versions[tenant_id]

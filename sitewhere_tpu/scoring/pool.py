@@ -22,6 +22,30 @@ operating point [SURVEY.md §7 hard part b]:
 The pool is keyed by (model name, model config): tenants selecting the
 same architecture share a stack regardless of their thresholds (applied
 host-side per tenant) or trained params (per-slot slices).
+
+**Cross-tenant megabatching (ROADMAP item 3).** This pool IS the
+megabatch dispatch path: `rule-processing: {megabatch: {enabled}}` (or
+`InstanceSettings.scoring_megabatch`) routes tenants here even without
+`shared: true`, collapsing the event loop's one-jit-dispatch-per-tenant
+-per-flush-round cost to ONE stacked dispatch per megabatch — the
+continuous-batching serving idiom (PAPERS.md, arXiv 2605.25645) that
+makes per-worker throughput a function of hardware, not dispatch
+overhead. Shapes stay compile-bounded: the tenant axis is the stack's
+pow2 capacity, the batch axis is pow2-bucketed (`batch_buckets`), and
+ragged per-tenant batches pad into each tenant's scratch row (the
+device-side `valid` mask — padding rows score garbage nobody reads).
+`megabatch: {window_ms}` sets the megabatch close deadline and
+`{max_tenants}` bounds tenants packed per round. Param hot-swap and
+tenant register/unregister replace the stacked pytree (never modify it
+— the dispatched jit keeps its own reference) and `_flush_round`
+snapshots per-tenant versions at dispatch, so an in-flight megabatch
+never observes a torn stack and every settled batch is attributed to
+the weights that scored it (`TenantStack.fence` counts the mutations
+the fence tests pin). The
+settled result fans back out through the per-slot deliver path
+(`kernel/egresslane.deliver_scored`, concurrently per tenant), so
+at-least-once commit discipline, alert emission, and the fused egress
+stage are untouched by the aggregation upstream.
 """
 
 from __future__ import annotations
@@ -35,6 +59,7 @@ from typing import Awaitable, Callable, Optional
 import numpy as np
 
 from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch, ScoredBatch
+from sitewhere_tpu.kernel.egresslane import deliver_scored
 from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.parallel.tenant_stack import TenantStack
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
@@ -63,10 +88,28 @@ class PoolConfig:
     # uses per-tenant thresholds as a runtime [T] vector
     readback: str = "full"
     sparse_k: int = 0
+    # megabatch window: how long the flusher holds an open megabatch
+    # for more tenants'/events' columns before closing it — the ≤1 ms
+    # of batching latency traded for the dispatch-rate collapse.
+    # 0 → batch_window_ms (the pool has always batched on a deadline;
+    # this knob lets the megabatch close faster or slower than the
+    # per-tenant admission window without touching it).
+    megabatch_window_ms: float = 0.0
+    # tenants packed into one stacked dispatch; 0 = every due tenant.
+    # The stack always computes all T_cap rows (vmap is shape-static),
+    # so this bounds HOST-side packing work and per-dispatch readback
+    # width, not device FLOPs — leftover tenants flush in the
+    # immediately following round.
+    max_tenants: int = 0
 
     @property
     def backlog_events(self) -> int:
         return self.backlog_cap or 4 * self.batch_buckets[-1]
+
+    @property
+    def window_s(self) -> float:
+        """Effective megabatch close deadline in seconds."""
+        return (self.megabatch_window_ms or self.batch_window_ms) / 1e3
 
 
 @dataclass
@@ -85,7 +128,12 @@ class _TenantEntry:
 class TenantSlot:
     """Per-tenant handle handed to the rule-processing engine; mirrors the
     `ScoringSession` admission surface so the processor loop treats both
-    the same way (pool-managed flushing → `flush_due` is always False)."""
+    the same way — including `flush_due`/`flush_nowait`, which delegate
+    to the POOL-wide megabatch state: on a busy event loop the consumer
+    lanes' turns drive flush rounds exactly as they drive a dedicated
+    session's (a lone background flusher task starves behind N
+    always-ready consumer loops — measured 5.5 rounds/s vs the lanes'
+    ~600 — so the flusher only backstops idle-period deadlines)."""
 
     def __init__(self, pool: "SharedScoringPool", tenant_id: str):
         self.pool = pool
@@ -106,11 +154,14 @@ class TenantSlot:
 
     @property
     def flush_due(self) -> bool:
-        return False
+        return self.pool.flush_due
+
+    def flush_nowait(self) -> bool:
+        return self.pool.flush_nowait()
 
     @property
     def flush_wait_s(self) -> float:
-        return 0.2
+        return self.pool.flush_wait_s
 
     @property
     def pending_n(self) -> int:
@@ -187,11 +238,18 @@ class SharedScoringPool:
     architecture."""
 
     def __init__(self, model, metrics: MetricsRegistry,
-                 cfg: PoolConfig = PoolConfig(), mesh=None, tracer=None):
+                 cfg: PoolConfig = PoolConfig(), mesh=None, tracer=None,
+                 faults=None):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
         self.tracer = tracer
+        # chaos seam (kernel/faults.py "scoring.megabatch"): consulted
+        # at admission — the one pool surface reached from inside a
+        # consumer loop's per-record quarantine, so an injected fault
+        # dead-letters the offending record with provenance instead of
+        # crashing the pool's (unsupervised) flusher task
+        self.faults = faults
         self.stack = TenantStack(model, mesh=mesh, seed=cfg.seed)
         self.ring: Optional[StackedDeviceRing] = None  # created on first register
         self.tenants: dict[str, _TenantEntry] = {}
@@ -214,6 +272,21 @@ class SharedScoringPool:
         self.flush_rounds = metrics.counter("scoring.pool_flush_rounds")
         self.dropped = metrics.counter("scoring.admissions_dropped")
         self.sink_failures = metrics.counter("scoring.sink_failures")
+        # megabatch observability: `scoring.dispatches` is the SAME
+        # registry counter the dedicated session incs (instance-wide jit
+        # dispatch rate, the A/B's denominator); megabatch_dispatches
+        # counts only stacked dispatches; tenants_per_dispatch shows how
+        # much cross-tenant aggregation each flush round achieved;
+        # stack_rebuilds surfaces capacity growths (each = a recompile
+        # round behind the warmup gate)
+        self.dispatches = metrics.counter("scoring.dispatches")
+        self.megabatch_dispatches = metrics.counter(
+            "scoring.megabatch_dispatches")
+        self.megabatch_tenants = metrics.histogram(
+            "scoring.megabatch_tenants_per_dispatch",
+            buckets=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0])
+        self.stack_rebuilds = metrics.counter("scoring.stack_rebuilds")
+        self._rebuilds_seen = 0
         # latency decomposition, pool-wide (same stage semantics as
         # ScoringSession: admit → batch → device → sink)
         self.stage_admit = metrics.histogram("scoring.stage_admit_s")
@@ -244,6 +317,7 @@ class SharedScoringPool:
             self.ring.ensure(self.stack.capacity, host_cap - 1)
             self.ring.clear_tenant(slot)  # a reused slot must not leak history
         self._seed_tenant_ring(tenant_id, slot, telemetry, params=params)
+        self._note_rebuilds()
         self._ensure_started()
         if self._current_key() != self._warmed_key:
             self._start_warmup()
@@ -363,6 +437,12 @@ class SharedScoringPool:
 
     def admit(self, tenant_id: str, batch: MeasurementBatch) -> None:
         entry = self.tenants[tenant_id]
+        if self.faults is not None:
+            # sync check (admit has no loop to block): a raised fault
+            # propagates to the admitting consumer's per-record
+            # quarantine — the record dead-letters with provenance and
+            # nothing was taken yet, so nothing is lost
+            self.faults.check("scoring.megabatch")
         mask = batch.mtype == self.cfg.mtype
         if mask.all():
             dev, val, ts = batch.device_index, batch.value, batch.ts
@@ -379,7 +459,7 @@ class SharedScoringPool:
         if dev.shape[0]:
             self._pending_max = max(self._pending_max, int(dev.max()))
         if self._deadline is None:
-            self._deadline = time.monotonic() + self.cfg.batch_window_ms / 1e3
+            self._deadline = time.monotonic() + self.cfg.window_s
         self._wake.set()
 
     # -- flushing -----------------------------------------------------------
@@ -387,6 +467,15 @@ class SharedScoringPool:
     @property
     def _total_pending(self) -> int:
         return sum(e.pending_n for e in self.tenants.values())
+
+    def _note_rebuilds(self) -> None:
+        """Publish stack capacity growths since the last look as the
+        `scoring.stack_rebuilds` counter (each growth = a bucket
+        recompile round behind the warmup gate)."""
+        d = self.stack.rebuilds - self._rebuilds_seen
+        if d > 0:
+            self.stack_rebuilds.inc(d)
+            self._rebuilds_seen = self.stack.rebuilds
 
     def _thresholds(self) -> np.ndarray:
         """Per-slot alert bars for the sparse step ([T_cap] f32);
@@ -404,6 +493,75 @@ class SharedScoringPool:
                 return b
         return self.cfg.batch_buckets[-1]
 
+    @property
+    def flush_due(self) -> bool:
+        """The megabatch is ready to close: pending work, warmed, under
+        the inflight cap, and either the megabatch window expired or
+        waiting can no longer improve the pack — i.e. every registered
+        tenant (up to the per-round `max_tenants` cap) already has a
+        full bucket's take. A total-pending bucket trigger (the first
+        cut) closed on ONE tenant's full payload and defeated the
+        cross-tenant window entirely: tenants-per-dispatch measured 0.8
+        where the whole point is >1 (the continuous-batching semantic:
+        hold the batch while it can still grow, never past the
+        deadline)."""
+        if not self.ready or self._total_pending == 0:
+            return False
+        if self.inflight >= self.cfg.max_inflight:
+            return False  # backpressure: let settles catch up
+        if time.monotonic() >= (self._deadline or 0.0):
+            return True
+        bucket = self.cfg.batch_buckets[-1]
+        quota = len(self.tenants)
+        if self.cfg.max_tenants:
+            quota = min(quota, self.cfg.max_tenants)
+        full = sum(1 for e in self.tenants.values()
+                   if e.pending_n >= bucket)
+        return quota > 0 and full >= quota
+
+    @property
+    def flush_wait_s(self) -> float:
+        """How long a consumer poll may wait before the megabatch
+        deadline (same contract as ScoringSession.flush_wait_s)."""
+        if self._total_pending == 0 or not self.ready:
+            return 0.2
+        if self.inflight >= self.cfg.max_inflight:
+            return 0.005
+        return max((self._deadline or 0.0) - time.monotonic(), 0.0)
+
+    def flush_nowait(self) -> bool:
+        """Close and dispatch the due megabatch NOW (called from the
+        consumer lanes' turns, like a session flush; the background
+        flusher backstops idle-period deadlines). Returns False when
+        nothing was due or a regrow held the round.
+
+        Drains the WHOLE pending backlog — bucket-sized stacked rounds
+        back-to-back — matching `ScoringSession.flush_nowait`'s chunked
+        drain: the inflight cap gates STARTING a flush, not its rounds.
+        A consumer poll can gulp far more than one bucket per tenant
+        (256 records × fleet-sized batches); leaving the excess pending
+        across turns is how the first cut ballooned slot backlogs until
+        the overload controller shed a flood the scorer could absorb."""
+        if not self.flush_due:
+            return False
+        if (self._pending_max >= self.ring.device_cap
+                or self.stack.capacity != self.ring.t_cap):
+            # a pending event outgrew the ring (or the stack grew):
+            # grow + recompile off the hot path; the ready gate holds
+            # flushes (and caps the backlog) meanwhile
+            self.ring.ensure(self.stack.capacity, self._pending_max)
+            self._start_warmup()
+            return False
+        self._deadline = None
+        while self._total_pending > 0:  # no awaits: admission can't race
+            self.flush_rounds.inc()
+            self._flush_round()
+        # a multi-round drain re-arms the deadline for its own leftovers
+        # (hot, in the past); clear it so the NEXT admission opens a
+        # fresh megabatch window instead of closing instantly unpacked
+        self._deadline = None
+        return True
+
     async def _run(self) -> None:
         while True:
             timeout = 0.2
@@ -416,35 +574,42 @@ class SharedScoringPool:
             self._wake.clear()
             if not self.ready or self._total_pending == 0:
                 continue
-            if (self._pending_max >= self.ring.device_cap
-                    or self.stack.capacity != self.ring.t_cap):
-                # a pending event outgrew the ring (or the stack grew):
-                # grow + recompile off the hot path; flushes held
-                self.ring.ensure(self.stack.capacity, self._pending_max)
-                self._start_warmup()
-                continue
             if self.inflight >= self.cfg.max_inflight:
                 await asyncio.sleep(0.005)
                 self._wake.set()
                 continue
-            if (self._deadline is not None
-                    and time.monotonic() >= self._deadline) \
-                    or self._total_pending >= self.cfg.batch_buckets[-1]:
-                self._deadline = None
-                self.flush_rounds.inc()
-                self._flush_round()
+            self.flush_nowait()
 
     def _flush_round(self) -> None:
-        """Take up to one bucket of rows from every tenant, dispatch ONE
-        vmapped call per occurrence round (events for the same device
-        within a take are applied and scored in arrival order, so a
-        coalesced backlog scores identically to per-tick flushes), and
-        schedule the settle. Leftovers re-queue (the wake stays set so
-        the next round follows immediately)."""
+        """Close the megabatch: take up to one bucket of rows from every
+        due tenant (bounded by `max_tenants` per round), pack them into
+        stacked `[T_cap, B]` columns, and dispatch ONE vmapped call per
+        occurrence round (events for the same device within a take are
+        applied and scored in arrival order, so a coalesced backlog
+        scores identically to per-tick flushes), then schedule the
+        settle. Leftovers — boundary-batch tails and tenants past the
+        per-round cap — re-queue (the wake stays set so the next round
+        follows immediately).
+
+        Version fence: per-tenant model versions are snapshotted here,
+        at dispatch time, and ride the metas into the settle — a param
+        hot-swap or register/unregister landing while this megabatch is
+        in flight can never tear the attribution (the dispatched jit
+        already holds its own reference to the stacked params it read).
+        """
+        self._note_rebuilds()
         takes: dict[str, tuple] = {}
+        max_t = self.cfg.max_tenants
         for tid, e in self.tenants.items():
             if e.pending_n == 0:
                 continue
+            if max_t and len(takes) >= max_t:
+                # tenants past the per-dispatch bound ride the next
+                # round, immediately (wake + hot deadline)
+                self._wake.set()
+                if self._deadline is None:
+                    self._deadline = time.monotonic()
+                break
             # take whole admitted batches up to the bucket budget; split
             # only the boundary batch — its tail re-queues WITH ITS OWN
             # ctx (the old concat-then-cut requeued the tail under the
@@ -494,7 +659,9 @@ class SharedScoringPool:
         t_cap, d_cap = self.ring.t_cap, self.ring.device_cap
 
         # split every tenant's take into occurrence rounds
-        metas = []  # (tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx)
+        # meta: (tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx,
+        #        version-at-dispatch)
+        metas = []
         round_parts: list[list[tuple[int, np.ndarray, np.ndarray]]] = []
         for tid, (dev, val, ts, ing, traces, ctx) in takes.items():
             slot = self.stack.slots[tid]
@@ -516,7 +683,8 @@ class SharedScoringPool:
                     round_parts.append([])
                 round_parts[r].append((slot, rdev, rval))
                 ev_rounds.append((r, rpos, rdev.shape[0]))
-            metas.append((tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx))
+            metas.append((tid, slot, n, dev, ts, ing, traces, ev_rounds,
+                          ctx, self.stack.versions.get(tid, 0)))
 
         t0 = time.monotonic()
         dispatches = []
@@ -540,6 +708,9 @@ class SharedScoringPool:
             self.dropped.inc(sum(m[2] for m in metas))
             self._recover_ring()
             return
+        self.dispatches.inc(len(dispatches))
+        self.megabatch_dispatches.inc(len(dispatches))
+        self.megabatch_tenants.observe(float(len(metas)))
         self.inflight += 1
         seq = self.dispatch_count
         self.dispatch_count += 1
@@ -574,7 +745,9 @@ class SharedScoringPool:
             self.batch_latency.observe(now - t0)
             self.stage_device.observe(now - t0)
             sparse = bool(settled) and isinstance(settled[0], tuple)
-            for tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx in metas:
+            deliveries: list[tuple[str, Deliver, ScoredBatch]] = []
+            for (tid, slot, n, dev, ts, ing, traces, ev_rounds, ctx,
+                 version) in metas:
                 e = self.tenants.get(tid)
                 if e is None:  # unregistered mid-flight
                     continue
@@ -605,7 +778,10 @@ class SharedScoringPool:
                     scored = ScoredBatch(
                         ctx, dev[fpos], a_scores,
                         np.ones(fpos.shape[0], bool), ts[fpos],
-                        model_version=self.stack.versions[tid],
+                        # the version snapshotted at DISPATCH, not the
+                        # live one: a swap landing mid-flight must not
+                        # claim scores the old weights computed
+                        model_version=version,
                         total_scored=n)
                 else:
                     scores = np.empty(n, np.float32)
@@ -620,22 +796,22 @@ class SharedScoringPool:
                         self.anomalies.inc(n_anom)
                     scored = ScoredBatch(
                         ctx, dev, scores, is_anom, ts,
-                        model_version=self.stack.versions[tid])
+                        model_version=version)
                 if self.tracer is not None:
                     for trace_id, n_ev in traces:
                         self.tracer.record(trace_id, "rule-processing.score",
                                            tid, t0, now - t0, n_ev)
-                t_sink = time.monotonic()
-                try:
-                    await e.deliver(scored)
-                except Exception:  # noqa: BLE001 - one tenant can't sink the pool
-                    self.sink_failures.inc()
-                    logger.exception("pool deliver failed for tenant %s", tid)
-                else:
-                    if not getattr(e.deliver, "owns_sink_stage", False):
-                        # fused egress delivery (kernel/egresslane.py)
-                        # observes settled→PUBLISHED itself
-                        self.stage_sink.observe(time.monotonic() - t_sink)
+                deliveries.append((tid, e.deliver, scored))
+            # settle fan-out (kernel/egresslane.py deliver_scored — the
+            # ONE delivery contract with the dedicated session): every
+            # tenant of the megabatch delivers CONCURRENTLY, failures
+            # counted and isolated per tenant, so one tenant's slow or
+            # broken sink never holds the rest of the fleet's results
+            if deliveries:
+                await asyncio.gather(*[
+                    deliver_scored(deliver, scored, self.sink_failures,
+                                   self.stage_sink, label=f"tenant {tid}")
+                    for tid, deliver, scored in deliveries])
         finally:
             self.inflight -= 1
             self.settled_count += 1

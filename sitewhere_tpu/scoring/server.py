@@ -40,6 +40,7 @@ import jax
 import numpy as np
 
 from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch, ScoredBatch
+from sitewhere_tpu.kernel.egresslane import deliver_scored
 from sitewhere_tpu.kernel.metrics import MetricsRegistry
 from sitewhere_tpu.persistence.telemetry import TelemetryStore
 from sitewhere_tpu.scoring.ring import DeviceRing
@@ -81,6 +82,13 @@ class ScoringConfig:
     # anomaly slots per flush in sparse mode; 0 → max(128, bucket/64).
     # Overflow is counted (scoring.anomaly_overflow), never silent.
     sparse_k: int = 0
+    # cross-tenant megabatch handoff (scoring/pool.py): when the engine
+    # routes this tenant through the shared pool, these shape the pool's
+    # stacked dispatch — the megabatch close deadline (0 → the pool
+    # falls back to batch_window_ms) and the tenants-per-dispatch bound
+    # (0 → every due tenant). Inert on a dedicated session.
+    megabatch_window_ms: float = 0.0
+    megabatch_max_tenants: int = 0
 
     @property
     def backlog_events(self) -> int:
@@ -141,6 +149,12 @@ class ScoringSession:
         self.anomaly_overflow = metrics.counter("scoring.anomaly_overflow")
         self.dropped = metrics.counter("scoring.admissions_dropped")
         self.sink_failures = metrics.counter("scoring.sink_failures")
+        # flush-path jit dispatches (one inc per compiled update+score
+        # call — chunks and occurrence rounds each count): the megabatch
+        # A/B's denominator. The pool incs the SAME registry counter, so
+        # `scoring.dispatches` is the instance-wide dispatch rate in
+        # both operating modes.
+        self.dispatches = metrics.counter("scoring.dispatches")
         # end-to-end latency decomposition (one observation per batch or
         # per flush — negligible overhead, and the p99 stops being a
         # single opaque number):
@@ -470,6 +484,7 @@ class ScoringSession:
                 except AttributeError:
                     pass
             self.batch_size_hist.observe(float(rdev.shape[0]))
+            self.dispatches.inc()
             dispatches.append((scores_dev, rdev.shape[0], rpos))
         return dispatches
 
@@ -560,17 +575,11 @@ class ScoringSession:
             if fut is not None and not fut.done():
                 fut.set_result(scored)
             if self.sink is not None:
-                try:
-                    await self.sink(scored)
-                except Exception:  # noqa: BLE001 - sink errors can't kill settles
-                    self.sink_failures.inc()
-                    logger.exception("scoring sink failed")
-                else:
-                    if not getattr(self.sink, "owns_sink_stage", False):
-                        # a fused egress sink (kernel/egresslane.py)
-                        # observes settled→PUBLISHED itself; timing the
-                        # enqueue here would record ~0 and hide the tail
-                        self.stage_sink.observe(time.monotonic() - now)
+                # ONE delivery contract with the pool's megabatch
+                # fan-out (kernel/egresslane.py): failure isolation +
+                # stage_sink ownership live in deliver_scored
+                await deliver_scored(self.sink, scored,
+                                     self.sink_failures, self.stage_sink)
         finally:
             self.inflight -= 1
             self.settled_count += 1

@@ -223,6 +223,14 @@ class QueueEventReceiver(BackgroundTaskComponent):
         # error): a rejected payload never enters the queue, and the
         # caller learns it was shed
         if self.engine.admit_ingress(payload) > 0:
+            # the reject path MUST suspend: accepted submits backpressure
+            # through the bounded queue, but a reject is a sync return —
+            # an in-process caller retrying in a tight loop would never
+            # yield the event loop, starving the very settle/flush tasks
+            # whose progress clears the overload that caused the reject
+            # (a measured live-lock: scoring froze while a flood sender
+            # spun on cheap rejects at 16M events/s)
+            await asyncio.sleep(0)
             return False
         # ingest time is stamped at arrival so queue wait under load is
         # part of measured end-to-end latency (no flattering p99s)

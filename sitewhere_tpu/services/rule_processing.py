@@ -68,6 +68,28 @@ logger = logging.getLogger(__name__)
 Hook = Callable[[object, "RuleApi"], Awaitable[None]]
 
 
+def megabatch_enabled(tenant, runtime) -> bool:
+    """Should this tenant score through the cross-tenant megabatch pool
+    (scoring/pool.py) instead of a dedicated per-tenant session?
+
+    Pure function of config (tenant `rule-processing: {megabatch:
+    {enabled}}` — or a bare bool — over `InstanceSettings
+    .scoring_megabatch`), so the bench lever and tests pin it
+    deterministically, and every engine of one instance reaches the
+    same answer. `shared: true` (config 4) routes to the pool
+    regardless; this predicate is the megabatch opt-in for tenants that
+    would otherwise run dedicated."""
+    rp = tenant.section("rule-processing", {"model": "zscore"})
+    if not rp.get("model", "zscore"):
+        return False  # scoring disabled: nothing to batch
+    mb = rp.get("megabatch")
+    if isinstance(mb, bool):
+        return mb
+    if isinstance(mb, dict) and "enabled" in mb:
+        return bool(mb["enabled"])
+    return bool(getattr(runtime.settings, "scoring_megabatch", False))
+
+
 def anomaly_alerts(scored: ScoredBatch, model_name: Optional[str]) -> AlertBatch:
     """Anomalous scored events → system alerts (source='model')."""
     idx = np.nonzero(scored.is_anomaly)[0]
@@ -110,19 +132,36 @@ class RuleProcessingEngine(TenantEngine):
         cfg = tenant.section("rule-processing", {"model": "zscore"})
         self.model_name: Optional[str] = cfg.get("model", "zscore")
         self.model_config: dict = cfg.get("model_config", {})
+        # cross-tenant megabatch (scoring/pool.py): routes this tenant
+        # through the shared stacked-params pool — one jit dispatch per
+        # flush round for every megabatched tenant of this architecture
+        self.megabatch: bool = megabatch_enabled(tenant, self.runtime)
+        mb_cfg = cfg.get("megabatch")
+        mb_cfg = mb_cfg if isinstance(mb_cfg, dict) else {}
+        settings = self.runtime.settings
         self.scoring_cfg = ScoringConfig(
             mtype=cfg.get("mtype", 0),
             threshold=cfg.get("threshold", 4.0),
             batch_window_ms=cfg.get("batch_window_ms",
-                                    self.runtime.settings.scoring_batch_window_ms),
+                                    settings.scoring_batch_window_ms),
             buckets=tuple(cfg.get("buckets",
-                                  self.runtime.settings.scoring_batch_buckets)),
+                                  settings.scoring_batch_buckets)),
             capacity=cfg.get("capacity", 0),
             max_inflight=cfg.get("max_inflight", 64),
             backlog_cap=cfg.get("backlog_cap", 0),
             score_dtype=cfg.get("score_dtype", "float16"),
             readback=cfg.get("readback", "full"),
             sparse_k=cfg.get("sparse_k", 0),
+            # megabatch close deadline + tenants-per-dispatch bound; 0
+            # window when megabatch is off keeps legacy `shared: true`
+            # pools on their admission window unchanged
+            megabatch_window_ms=(float(mb_cfg.get(
+                "window_ms",
+                getattr(settings, "scoring_megabatch_window_ms", 1.0)))
+                if self.megabatch else 0.0),
+            megabatch_max_tenants=int(mb_cfg.get(
+                "max_tenants",
+                getattr(settings, "scoring_megabatch_max_tenants", 0))),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.shared: bool = cfg.get("shared", False)
@@ -184,7 +223,10 @@ class RuleProcessingEngine(TenantEngine):
             return
         em = await self.runtime.wait_for_engine("event-management",
                                                 self.tenant_id)
-        if self.shared:
+        if self.shared or self.megabatch:
+            # the shared-pool handoff: config 4 (`shared: true`) and the
+            # megabatch opt-in both land here — one stacked-params pool
+            # per architecture, one jit dispatch per flush round
             pool = self.service.shared_pool(
                 self.model_name, self.model_config, self.scoring_cfg,
                 self.mesh_spec)
@@ -395,8 +437,10 @@ class RuleProcessor(BackgroundTaskComponent):
         engine = self.engine
         runtime = engine.runtime
         tenant_id = engine.tenant_id
-        # sink: dedicated session or the shared pool's tenant slot (the pool
-        # flushes itself; slot.flush_due is constant-False)
+        # sink: dedicated session or the shared pool's tenant slot —
+        # slots delegate flush_due/flush_nowait to the POOL, so this
+        # loop's turns drive the shared megabatch rounds exactly as
+        # they drive a session's flushes
         sink = engine.session or engine.pool_slot
         session = engine.session
         api = RuleApi(engine)
@@ -430,6 +474,14 @@ class RuleProcessor(BackgroundTaskComponent):
         cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
         if not cap and engine.pool_slot is not None:
             cap = engine.pool_slot.pool.cfg.backlog_events
+        # pool slots deliberately report max_inflight=0 (inflight
+        # pressure omitted): a slot's inflight counts STACKED dispatches
+        # the tenant rode, and every megabatched tenant rides every
+        # round — healthy pipelining pegs it at the pool cap for the
+        # whole fleet at once, which read as pressure 0.5 (= the reject
+        # threshold) and shed floods the scorer was absorbing. The
+        # per-tenant overload truth for a megabatched tenant is its OWN
+        # backlog (pending vs cap), reported above per poll round.
         max_inflight = getattr(getattr(session, "cfg", None),
                                "max_inflight", 0)
 
@@ -452,9 +504,10 @@ class RuleProcessor(BackgroundTaskComponent):
                     # (at-least-once within the retention window; past it
                     # the consumer's lost_records counts the trim) instead
                     # of being dropped after consume. Keep flushing so the
-                    # backlog drains.
-                    if session is not None and session.flush_due:
-                        session.flush_nowait()
+                    # backlog drains (sessions AND pool slots: a slot's
+                    # flush drives the shared megabatch round).
+                    if sink.flush_due:
+                        sink.flush_nowait()
                     await asyncio.sleep(
                         max(sink.flush_wait_s, 0.001) if sink.ready else 0.05)
                     continue
@@ -495,11 +548,15 @@ class RuleProcessor(BackgroundTaskComponent):
                             await hook(value, api)
                         except Exception:  # noqa: BLE001 - hook errors isolated
                             logger.exception("hook %s failed", name)
-                if session is not None and session.flush_due:
+                if sink is not None and sink.flush_due:
                     # pipelined: dispatch now; the settled batch reaches
-                    # engine._deliver_scored (publish + alerts) via the
-                    # session sink without blocking this consumer loop
-                    session.flush_nowait()
+                    # the scored sink (publish + alerts) without blocking
+                    # this consumer loop. Pool slots delegate to the
+                    # SHARED megabatch round — consumer turns drive the
+                    # stacked dispatch cadence exactly as they drive a
+                    # dedicated session's (the pool's background flusher
+                    # would starve behind N busy consumer loops)
+                    sink.flush_nowait()
                 # refresh the mode AFTER the poll/admit: the pre-poll
                 # value is stale by up to the poll timeout, and a drain
                 # decision made on it could replay records spooled within
@@ -585,16 +642,24 @@ class RuleProcessingService(Service):
                 mesh = make_mesh(data=mesh_spec.get("data"),
                                  model=mesh_spec.get("model", 1))
             model = build_model(model_name, **model_config)
+            # megabatch shaping knobs (window, tenants-per-dispatch,
+            # inflight bound) are POOL-wide: the first registrant's
+            # values win — splitting pools on them would defeat the
+            # cross-tenant batching they exist for
             pool = SharedScoringPool(
                 model, self.runtime.metrics,
                 PoolConfig(batch_buckets=scoring_cfg.buckets,
                            batch_window_ms=scoring_cfg.batch_window_ms,
                            mtype=scoring_cfg.mtype, seed=scoring_cfg.seed,
+                           max_inflight=scoring_cfg.max_inflight,
                            backlog_cap=scoring_cfg.backlog_cap,
                            score_dtype=scoring_cfg.score_dtype,
                            readback=scoring_cfg.readback,
-                           sparse_k=scoring_cfg.sparse_k),
-                mesh=mesh, tracer=self.runtime.tracer)
+                           sparse_k=scoring_cfg.sparse_k,
+                           megabatch_window_ms=scoring_cfg.megabatch_window_ms,
+                           max_tenants=scoring_cfg.megabatch_max_tenants),
+                mesh=mesh, tracer=self.runtime.tracer,
+                faults=self.runtime.faults)
             self._pools[key] = pool
         return pool
 

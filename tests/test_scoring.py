@@ -324,6 +324,35 @@ def test_admission_backpressure_never_drops(run):
     run(main())
 
 
+def test_session_counts_flush_dispatches(run):
+    """`scoring.dispatches` counts flush-path jit calls (chunks and
+    occurrence rounds included) — the megabatch A/B's denominator, so
+    the dedicated session must inc the same registry counter the pool
+    does (query-path scoring never counts)."""
+
+    async def main():
+        store = TelemetryStore(history=64)
+        sim = DeviceSimulator(SimConfig(num_devices=300), tenant_id="t")
+        _fill_store(store, sim, 40)
+        metrics = MetricsRegistry()
+        session = ScoringSession(
+            build_model("zscore", window=32), store, metrics,
+            ScoringConfig(buckets=(128,), batch_window_ms=0.0))
+        session.warmup()
+        counter = metrics.counter("scoring.dispatches")
+        assert counter.value == 0  # warmup dispatches are not flushes
+        batch, _ = sim.tick(t=41 * 60.0)
+        session.admit(batch)   # 300 devices > bucket 128 → 3 chunks
+        await session.flush()
+        assert counter.value == 3
+        # megabatch handoff fields default inert on a dedicated session
+        assert session.cfg.megabatch_window_ms == 0.0
+        assert session.cfg.megabatch_max_tenants == 0
+        session.close()
+
+    run(main())
+
+
 def test_backlog_cap_is_configurable(run):
     """The admission cap is a latency knob (a standing queue of B events
     adds B/rate seconds of tail): default 4 full buckets, overridable
