@@ -967,6 +967,10 @@ async def run_bench(args) -> dict:
     rt = ServiceRuntime(InstanceSettings(
         instance_id="bench", engine_ready_timeout_s=args.ready_timeout,
         data_dir=args.durable,
+        # --no-observe: the flight-recorder A/B lever (ab_compare.py
+        # observe preset) — off leg runs with no telemetry beat and the
+        # artifact's `observe` block absent
+        observe_enabled=not args.no_observe,
         # the saturation phase floods an unbounded open loop, so the
         # overload controller's reject-at-ingress is the correct (and
         # measured: `scoring.ingress_rejected`) shed; degrade/defer
@@ -1268,6 +1272,30 @@ async def run_bench(args) -> dict:
         spill = {"written": sum(d.written for d in logs if d),
                  "dropped": sum(d.dropped for d in logs if d)}
 
+    # flight-recorder block (kernel/observe.py): consumer-lag max,
+    # loop-lag quantiles + stall count, and the critical-path stage
+    # table — collected before rt.stop() tears the beat down. None when
+    # --no-observe (the A/B off leg's artifact shows the lever plainly).
+    observe = None
+    if rt.beat is not None:
+        from sitewhere_tpu.kernel.observe import observe_report
+
+        rep = observe_report(rt)
+        beat_snap = rep["beat"] or {}
+        cp = rep["critical_path"]
+        observe = {
+            "beats": beat_snap.get("beats", 0),
+            "consumer_lag_max": beat_snap.get("consumer_lag_max", 0),
+            "loop_lag_p99_ms": beat_snap.get("loop_lag_ms", {}).get(
+                "p99", 0.0),
+            "loop_lag_max_ms": beat_snap.get("loop_lag_ms", {}).get(
+                "max", 0.0),
+            "loop_stalls": beat_snap.get("loop_stalls", 0),
+            "queue_wait_p99_ms": cp["queue_wait_p99_ms"],
+            "service_p99_ms": cp["service_p99_ms"],
+            "critical_path": cp["stages"],
+        }
+
     chaos = None
     if fi is not None:
         restarts = rt.metrics.counter("supervisor.restarts").value
@@ -1359,6 +1387,7 @@ async def run_bench(args) -> dict:
                      else "full"),
         "durable": bool(args.durable),
         "durable_spill": spill,
+        "observe": observe,
         "chaos": chaos,
         "lint": _lint_summary(),
         "chips": n_chips,
@@ -1493,6 +1522,11 @@ def main() -> None:
                         help="max injected faults per site (bounded so "
                              "the 5/60s restart budget is never exceeded "
                              "by design)")
+    parser.add_argument("--no-observe", action="store_true",
+                        help="disable the pipeline flight recorder "
+                             "(telemetry beat, kernel/observe.py) — the "
+                             "A/B lever for measuring its overhead; the "
+                             "artifact's 'observe' block is absent")
     parser.add_argument("--no-fastlane", action="store_true",
                         help="pin the staged slow lane (disable the fused "
                              "ingress fast lane) — the A/B lever for "

@@ -20,6 +20,9 @@ Presets (the levers bench.py exposes):
               one jit call per flush round for the fleet), off =
               `--no-megabatch --tenants N` (one dispatch per tenant
               per round) — the dispatch-rate-collapse A/B
+    observe   on = pipeline flight recorder (telemetry beat + trace
+              spine, default), off = `--no-observe` — the paired
+              overhead run (acceptance: saturation median within 3%)
 
 Usage:
 
@@ -144,7 +147,7 @@ def main() -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
-                                           "megabatch"])
+                                           "megabatch", "observe"])
     parser.add_argument("--lanes", type=int, default=2,
                         help="egress/consumer lane count for the sharded "
                              "run (egress + lanes presets)")
@@ -176,6 +179,9 @@ def main() -> int:
                  ("on", ["--tenants", t])]
         names = (f"megabatch off ({t} tenants)",
                  f"megabatch on ({t} tenants)")
+    elif args.preset == "observe":
+        pairs = [("off", ["--no-observe"]), ("on", [])]
+        names = ("observe off", "observe on")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",

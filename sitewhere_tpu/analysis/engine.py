@@ -168,9 +168,14 @@ def default_checkers() -> list[Checker]:
         check_fault_sites,
         check_metric_names,
     )
+    from sitewhere_tpu.analysis.checkers_trace import (
+        check_trace_parity,
+        check_trace_stages,
+    )
 
     return [check_async_blocking, check_flow_consult, check_dlq_quarantine,
-            check_fault_sites, check_metric_names, check_lifecycle_super]
+            check_fault_sites, check_metric_names, check_lifecycle_super,
+            check_trace_parity, check_trace_stages]
 
 
 # -- baseline ----------------------------------------------------------------

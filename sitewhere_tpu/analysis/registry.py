@@ -17,6 +17,10 @@ via `faults.check(site)` / `await faults.acheck(site)`.
 Adding a metric: add the base name (the part before any `:tenant`
 suffix) under its kind below. A name may have exactly ONE kind — the
 import-time check at the bottom fails the build on a conflict.
+Adding a trace stage: add `(name, kind)` to `TRACE_STAGES` in pipeline
+order (kind: "queue" = time spent waiting, "service" = time spent
+working — the critical-path analyzer's split), then record it via
+`tracer.record(trace_id, name, ...)`; TRC01 resolves the literal here.
 """
 
 from __future__ import annotations
@@ -34,7 +38,37 @@ FAULT_SITES = frozenset({
     "scoring.megabatch",  # scoring/pool.py megabatch admission
     "flow.admit",         # kernel/flow.py ingress admission
     "flow.shed",          # kernel/flow.py shed-mode consult
+    "observe.beat",       # kernel/observe.py telemetry-beat sampler tick
 })
+
+# -- trace stages (kernel/tracing.py spans; TRC01 resolves literals) ---------
+# Pipeline order matters: the critical-path report renders in this order.
+# kind "queue" = waiting (receiver arrival → decode start, admission →
+# dispatch, deferred spool → replay), "service" = working. One name, one
+# kind — a stage is either where events wait or where they are served.
+
+TRACE_STAGES: tuple[tuple[str, str], ...] = (
+    ("event-sources.receive", "queue"),      # arrival → decode start
+    ("event-sources.decode", "service"),     # SWB1/JSON decode
+    ("inbound.enrich", "service"),           # mask validate + split
+    ("event-management.persist", "service"), # columnar store scatter
+    ("rule-processing.dispatch", "queue"),   # admission → jit dispatch
+    ("rule-processing.score", "service"),    # dispatch → scores on host
+    ("egress.publish", "service"),           # settled → published
+    ("flow.defer", "service"),               # overload spool publish
+    ("flow.replay", "queue"),                # deferred drain re-admission
+    ("dlq.quarantine", "service"),           # poison → dead-letter topic
+    ("dlq.replay", "service"),               # dead letter → original topic
+)
+
+TRACE_STAGE_KINDS: dict[str, str] = dict(TRACE_STAGES)
+if len(TRACE_STAGE_KINDS) != len(TRACE_STAGES):
+    raise ValueError("duplicate trace stage in TRACE_STAGES")
+
+
+def trace_stage_kind(name: str) -> str | None:
+    """Registered kind for a trace stage name, or None if unknown."""
+    return TRACE_STAGE_KINDS.get(name)
 
 # -- metric base names, by kind (kernel/metrics.py registry) ----------------
 # Per-tenant variants use the `:{tenant_id}` suffix on the same base name
@@ -84,11 +118,20 @@ COUNTERS = (
     "flow.shed_reject",
     "flow.shed_degrade",
     "flow.shed_defer",
+    # flight recorder (kernel/observe.py)
+    "observe.beats",
+    "observe.loop_stalls",
 )
 
 GAUGES = (
     "flow.pressure",
     "flow.shed_level",
+    # flight recorder (kernel/observe.py): per-group/tenant variants use
+    # the `:{suffix}` convention on the same base names
+    "observe.consumer_lag",
+    "observe.egress_backlog",
+    "observe.scoring_pending",
+    "observe.scoring_inflight",
 )
 
 METERS = (
@@ -111,6 +154,8 @@ HISTOGRAMS = (
     "scoring.stage_device_s",
     "scoring.stage_sink_s",
     "scoring.megabatch_tenants_per_dispatch",
+    # flight recorder (kernel/observe.py): event-loop lag per beat
+    "observe.loop_lag_s",
 )
 
 # f-string metric names whose suffix is computed at runtime

@@ -9,6 +9,7 @@ rebuild ships one:
   swx demo                                         run + simulate + score, one process
   swx dlq list|replay --tenant T                   inspect/replay dead letters
   swx quota show|set --tenant T                    flow-control quotas
+  swx top [--interval S] [--once]                  live flight-recorder view
   swx lint [--format json]                         static invariant checks
 
 `run` starts every service, creates tenants from the YAML (or a default
@@ -450,6 +451,116 @@ async def cmd_quota(args) -> int:
         return 1
 
 
+def render_top(report: dict) -> str:
+    """Render one flight-recorder report (`GET /api/instance/observe`)
+    as the `swx top` screen. Pure function — tests and --json callers
+    drive it directly."""
+    lines: list[str] = []
+    beat = report.get("beat")
+    cp = report.get("critical_path") or {}
+    if beat is None:
+        lines.append("telemetry beat: DISABLED (observe_enabled=false)")
+    else:
+        lag = beat.get("loop_lag_ms", {})
+        lines.append(
+            f"beats {beat.get('beats', 0)}  "
+            f"interval {beat.get('interval_ms', 0):.0f}ms  "
+            f"loop-lag p50/p99/max {lag.get('p50', 0):.2f}/"
+            f"{lag.get('p99', 0):.2f}/{lag.get('max', 0):.2f}ms  "
+            f"stalls {beat.get('loop_stalls', 0)}  "
+            f"consumer-lag max {beat.get('consumer_lag_max', 0)}")
+    lines.append("")
+    lines.append(f"critical path (sampled 1/{cp.get('sample', '?')}, "
+                 f"{cp.get('span_count', 0)} spans) — queue-wait p99 "
+                 f"{cp.get('queue_wait_p99_ms', 0):.2f}ms vs service p99 "
+                 f"{cp.get('service_p99_ms', 0):.2f}ms")
+    lines.append(f"  {'stage':<28} {'kind':<8} {'count':>6} "
+                 f"{'p50ms':>8} {'p95ms':>8} {'p99ms':>8}")
+    for stage, row in (cp.get("stages") or {}).items():
+        lines.append(
+            f"  {stage:<28} {row.get('kind', '?'):<8} "
+            f"{row.get('count', 0):>6} {row.get('p50_ms', 0):>8.2f} "
+            f"{row.get('p95_ms', 0):>8.2f} {row.get('p99_ms', 0):>8.2f}")
+    if not cp.get("stages"):
+        lines.append("  (no sampled spans yet)")
+    last = (beat or {}).get("last") or {}
+    if last:
+        lags = last.get("consumer_lag") or {}
+        top_lags = sorted(lags.items(), key=lambda kv: -kv[1])[:8]
+        if top_lags:
+            lines.append("")
+            lines.append("consumer lag by group:")
+            for group, lag_n in top_lags:
+                lines.append(f"  {group:<44} {lag_n:>8}")
+        scoring = last.get("scoring") or {}
+        egress = last.get("egress_backlog") or {}
+        flow = last.get("flow") or {}
+        tenants = sorted(set(scoring) | set(egress) | set(flow))
+        if tenants:
+            lines.append("")
+            lines.append(f"  {'tenant':<20} {'mode':<9} {'pressure':>8} "
+                         f"{'pending':>8} {'inflight':>8} {'egress':>7}")
+            for tid in tenants:
+                sc = scoring.get(tid, {})
+                fl = flow.get(tid, {})
+                lines.append(
+                    f"  {tid:<20} {fl.get('mode', '-'):<9} "
+                    f"{fl.get('pressure', 0):>8.3f} "
+                    f"{sc.get('pending', 0):>8} "
+                    f"{sc.get('inflight', 0):>8} "
+                    f"{egress.get(tid, 0):>7}")
+    return "\n".join(lines)
+
+
+async def cmd_top(args) -> int:
+    """Live operator view over `GET /api/instance/observe` — the
+    flight recorder's critical path, loop-lag probe, consumer lag, and
+    per-tenant flow/scoring state, refreshed every --interval."""
+    import base64
+
+    basic = base64.b64encode(
+        f"{args.user}:{args.password}".encode()).decode()
+    try:
+        status, out = await _http_json(
+            "POST", args.host, args.port, "/api/jwt",
+            headers={"Authorization": f"Basic {basic}"})
+        if status != 200:
+            print(f"swx top: authentication failed ({status}): {out}",
+                  file=sys.stderr)
+            return 1
+        headers = {"Authorization": f"Bearer {out['token']}"}
+        path = "/api/instance/observe"
+        if args.tenant:
+            path += f"?tenant={args.tenant}"
+        while True:
+            status, report = await _http_json("GET", args.host, args.port,
+                                              path, headers=headers)
+            if status != 200:
+                print(f"swx top: observe failed ({status}): {report}",
+                      file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(report))
+            else:
+                if not args.once:
+                    # clear + home, like top(1); --once keeps scrollback
+                    print("\x1b[2J\x1b[H", end="")
+                print(f"swx top — {args.host}:{args.port}"
+                      + (f" tenant={args.tenant}" if args.tenant else ""))
+                print(render_top(report))
+            if args.once:
+                return 0
+            await asyncio.sleep(max(args.interval, 0.2))
+    except (OSError, asyncio.TimeoutError, IndexError, ValueError) as exc:
+        print(f"swx top: cannot reach REST at {args.host}:{args.port}: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return 1
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        # Ctrl-C reaches the coroutine as CancelledError under
+        # asyncio.run — the operator's normal exit, not a traceback
+        return 0
+
+
 async def cmd_simulate(args) -> int:
     from sitewhere_tpu.sim.clients import make_sender
     from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
@@ -745,6 +856,24 @@ def main(argv=None) -> int:
     p_quota.add_argument("--user", default="admin")
     p_quota.add_argument("--password", default="password")
 
+    p_top = sub.add_parser("top", parents=[common],
+                           help="live flight-recorder view (critical "
+                                "path, loop lag, consumer lag, flow "
+                                "modes) via the REST API")
+    p_top.add_argument("--host", default="127.0.0.1")
+    p_top.add_argument("--port", type=int, default=8080, help="REST port")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="refresh period in seconds")
+    p_top.add_argument("--once", action="store_true",
+                       help="print one report and exit (scripts/tests)")
+    p_top.add_argument("--json", action="store_true",
+                       help="print the raw observe JSON instead of the "
+                            "rendered table")
+    p_top.add_argument("--tenant", default=None,
+                       help="filter the critical path to one tenant")
+    p_top.add_argument("--user", default="admin")
+    p_top.add_argument("--password", default="password")
+
     p_lint = sub.add_parser(
         "lint", parents=[common],
         help="run swxlint, the AST-based invariant checker "
@@ -816,7 +945,7 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", "cpu")
     coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
             "train": cmd_train, "serve-bus": cmd_serve_bus,
-            "dlq": cmd_dlq, "quota": cmd_quota}[args.cmd]
+            "dlq": cmd_dlq, "quota": cmd_quota, "top": cmd_top}[args.cmd]
     return asyncio.run(coro(args))
 
 

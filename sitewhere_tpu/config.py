@@ -36,6 +36,16 @@ class InstanceSettings:
     jwt_expiration_s: int = 3600
     # scoring plane
     trace_sample: int = 64     # record spans for every Nth trace [SURVEY §5.1]
+    # pipeline flight recorder (kernel/observe.py): the always-on
+    # telemetry beat samples event-loop lag, consumer-group lag, egress
+    # backlog, scoring occupancy, and flow mode every `interval_ms` into
+    # a bounded ring of `observe_ring` samples; loop lag past
+    # `observe_stall_ms` counts a stall (the PR-6 starved-loop class).
+    # `observe_enabled: false` (bench `--no-observe`) is the A/B lever.
+    observe_enabled: bool = True
+    observe_interval_ms: float = 250.0
+    observe_ring: int = 256
+    observe_stall_ms: float = 100.0
     scoring_batch_window_ms: float = 2.0
     scoring_batch_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
     # cross-tenant megabatched scoring (scoring/pool.py): when enabled,

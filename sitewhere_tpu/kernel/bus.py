@@ -157,6 +157,36 @@ class EventBus(LifecycleComponent):
         self.create_topic(topic)
         return [p.end_offset for p in self._topics[topic].partitions]
 
+    def group_lags(self) -> dict[str, dict[str, int]]:
+        """Consumer lag per group: head offset minus committed offset,
+        summed per topic — the telemetry beat's backlog signal
+        (kernel/observe.py) and the input ROADMAP item 2's placement
+        controller scales replicas on. A partition a group never
+        committed counts its full retained backlog (earliest-reset
+        semantics: every retained record is still ahead of the group)."""
+        out: dict[str, dict[str, int]] = {}
+        for group, state in self._groups.items():
+            lags: dict[str, int] = {}
+            # union member subscriptions with committed-offset topics: a
+            # group whose consumers all died (crash window, reconfigure)
+            # must keep reporting its growing backlog — that outage is
+            # exactly when this signal matters
+            topics = {t for m in state.members for t in m._topics} \
+                | {t for t, _ in state.committed}
+            for topic_name in topics:
+                topic = self._topics.get(topic_name)
+                if topic is None:
+                    continue
+                total = 0
+                for p, log in enumerate(topic.partitions):
+                    committed = state.committed.get((topic_name, p),
+                                                    log.base_offset)
+                    total += max(log.end_offset - committed, 0)
+                if total:
+                    lags[topic_name] = total
+            out[group] = lags
+        return out
+
     def peek(self, topic: str, *, limit: int = 100) -> list[TopicRecord]:
         """Admin read: the newest `limit` retained records of `topic`
         across partitions, oldest-first, without joining any consumer

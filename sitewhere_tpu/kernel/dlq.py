@@ -39,13 +39,30 @@ def summarize_error(exc: BaseException) -> str:
     return f"{type(exc).__name__}: {exc}"[:_ERR_MAX]
 
 
+def _trace_of(value) -> tuple[int, int]:
+    """(trace_id, n_events) of a record value's batch ctx, (0, 0) when
+    the value has none — poison may blow up on any attribute access, so
+    every read is defensive."""
+    try:
+        trace_id = int(getattr(getattr(value, "ctx", None), "trace_id", 0))
+    except Exception:  # noqa: BLE001 - poison defends itself
+        return 0, 0
+    try:
+        n = len(value)
+    except Exception:  # noqa: BLE001
+        n = 0
+    return trace_id, n
+
+
 async def quarantine(bus, dlq_topic: str, record, exc: BaseException,
                      stage: str, metrics=None,
-                     tenant_id: Optional[str] = None) -> None:
+                     tenant_id: Optional[str] = None,
+                     tracer=None) -> None:
     """Publish a poison record to the tenant's dead-letter topic.
 
     Never raises: a DLQ publish failure is logged and counted — the
     consuming loop must keep draining either way."""
+    t0 = time.monotonic()
     entry = {
         "original_topic": record.topic,
         "partition": record.partition,
@@ -71,6 +88,12 @@ async def quarantine(bus, dlq_topic: str, record, exc: BaseException,
         metrics.counter("dlq.quarantined").inc()
         if tenant_id:
             metrics.counter(f"dlq.quarantined:{tenant_id}").inc()
+    if tracer is not None:
+        # the quarantine is part of the record's journey: a sampled
+        # trace that dead-letters shows WHERE it left the pipeline
+        trace_id, n = _trace_of(record.value)
+        tracer.record(trace_id, "dlq.quarantine", tenant_id or "",
+                      t0, time.monotonic() - t0, n)
 
 
 def list_dead_letters(bus, dlq_topic: str, limit: int = 100) -> list:
@@ -85,7 +108,8 @@ def list_dead_letters(bus, dlq_topic: str, limit: int = 100) -> list:
 async def replay_dead_letters(bus, dlq_topic: str, *,
                               limit: Optional[int] = None,
                               metrics=None, flow=None,
-                              tenant_id: Optional[str] = None) -> int:
+                              tenant_id: Optional[str] = None,
+                              tracer=None) -> int:
     """Re-produce dead letters onto their original topics; returns the
     count replayed. Progress is committed under a per-topic replay
     group, so a second replay call continues where the last stopped.
@@ -120,9 +144,18 @@ async def replay_dead_letters(bus, dlq_topic: str, *,
                         logger.info("dlq replay for %s paused over quota "
                                     "after %d records", tenant_id, replayed)
                         break   # NOT committed: the next replay resumes here
+                t0 = time.monotonic()
                 await bus.produce(entry["original_topic"], entry["value"],
                                   key=entry.get("key"))
                 replayed += 1
+                if tracer is not None:
+                    # replay re-enters the pipeline under the SAME trace
+                    # id: the journey shows quarantine → replay → the
+                    # stages the second pass records
+                    trace_id, n = _trace_of(entry["value"])
+                    tracer.record(trace_id, "dlq.replay",
+                                  tenant_id or "", t0,
+                                  time.monotonic() - t0, n)
             # else: foreign record on the DLQ topic — skip, still commit
             consumer.commit()
     finally:

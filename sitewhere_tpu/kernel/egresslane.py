@@ -160,6 +160,7 @@ class EgressStage:
     def __init__(self, engine, lanes: int = 1):
         self.engine = engine
         self.scored_topic = engine.tenant_topic(TopicNaming.SCORED_EVENTS)
+        self.tracer = engine.runtime.tracer
         metrics = engine.runtime.metrics
         self.published_meter = metrics.meter("egress.events_published")
         self.publish_failures = metrics.counter("egress.publish_failures")
@@ -242,7 +243,15 @@ class EgressStage:
             except Exception:  # noqa: BLE001 - shard path quarantines
                 pass  # fall through: the shard publishes (or DLQs) it
             else:
-                self.stage_sink.observe(time.monotonic() - t_submit)
+                now = time.monotonic()
+                self.stage_sink.observe(now - t_submit)
+                # the trace spine's egress terminus: the sampled trace
+                # of a scored event ends at this publish (sync fast
+                # path — the span IS the bare append)
+                self.tracer.record(
+                    getattr(scored.ctx, "trace_id", 0), "egress.publish",
+                    self.engine.tenant_id, t_submit, now - t_submit,
+                    len(scored))
                 self.published_meter.mark(len(scored))
                 self.accounted += 1
                 if (self.engine.emit_alerts
@@ -318,7 +327,14 @@ class EgressShard(BackgroundTaskComponent):
                             _unpublished(stage.scored_topic, scored),
                             exc, self.path)
                         continue
-                    stage.stage_sink.observe(time.monotonic() - t_submit)
+                    now = time.monotonic()
+                    stage.stage_sink.observe(now - t_submit)
+                    # shard-path publish span: submit → published on the
+                    # bus, the same semantics as the sync fast path's
+                    stage.tracer.record(
+                        getattr(scored.ctx, "trace_id", 0),
+                        "egress.publish", engine.tenant_id, t_submit,
+                        now - t_submit, len(scored))
                     stage.published_meter.mark(len(scored))
                     stage.accounted += 1
                     self.pending_publishes -= 1

@@ -136,8 +136,11 @@ async def checkpoint_commit(consumer, sink,
 
 # both callers (FastLane._handle and InboundProcessor's record wrapper)
 # charge `admit_fair` BEFORE invoking this shared core — consulting here
-# too would double-bill every batch, same rationale as process_payload
-async def validate_and_split(batch, dm, runtime, unregistered_topic,  # swxlint: disable=FLW01
+# too would double-bill every batch, same rationale as process_payload.
+# TRC01: the span for this path is the caller's "inbound.enrich" (both
+# lanes record it around this call on the same record) — a second span
+# here would double-count the validate work in the critical path.
+async def validate_and_split(batch, dm, runtime, unregistered_topic,  # swxlint: disable=FLW01,TRC01
                              dropped):
     """The registration-mask validation BOTH lanes share: gather the
     mask, split unregistered devices to the unregistered-device topic,

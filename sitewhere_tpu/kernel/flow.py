@@ -556,6 +556,15 @@ class FlowController:
 
     # -- introspection -------------------------------------------------------
 
+    def modes(self) -> dict[str, dict]:
+        """Every known tenant's live shed state (mode + pressure +
+        forced override) — the telemetry beat's per-tenant flow sample
+        (kernel/observe.py). Read-only: never creates tenant state."""
+        return {tid: {"mode": tf.overload.current,
+                      "pressure": round(tf.overload.pressure, 4),
+                      "forced": tf.overload.forced}
+                for tid, tf in self._tenants.items()}
+
     def quota(self, tenant_id: str) -> dict:
         tf = self._tenant(tenant_id)
         out = {

@@ -1,0 +1,214 @@
+"""Pipeline flight recorder: the always-on telemetry beat + observe report.
+
+PR 6's three live-locks — a starved pool flusher, pegged slot-inflight
+pressure misread as overload, a sync-reject spin — were each found by
+hand, because nothing watched queue lag or event-loop health while the
+pipeline ran. This module is the instrument panel (the PMU streaming
+architecture, arXiv 2512.22231, is the pattern reference: a cheap
+always-on observer beside the stream, never in it), and ROADMAP item
+2's placement controller (ADApt, arXiv 2504.03698) reads exactly these
+backlog/lag signals as its replica-prediction inputs.
+
+`TelemetryBeat` is a supervised loop (one per ServiceRuntime,
+`observe: {enabled}` / `InstanceSettings.observe_enabled`) that wakes
+every `observe_interval_ms` and samples, into a bounded ring AND the
+metrics registry (so Prometheus exposition rides the existing
+`prometheus_text()` with zero new plumbing):
+
+- **event-loop lag**: the drift between when the beat asked to wake and
+  when the loop actually ran it. A loop that stops yielding — the PR-6
+  starvation class — shows up within ONE beat as a lag spike; past
+  `observe_stall_ms` it counts `observe.loop_stalls` and logs loudly.
+- **consumer lag** per group (committed offset vs head), via
+  `EventBus.group_lags()` — the backlog signal autoscaling needs.
+- **egress shard backlog** and **scoring occupancy** (pending/inflight)
+  per rule-processing engine.
+- **flow mode + pressure** per tenant (`FlowController.modes()`).
+
+Sampling cost is a handful of dict walks over per-tenant engines — no
+locks, no awaits inside the sample — so the beat is safe to leave on in
+production (the same-day A/B `ab_compare.py observe` pins the overhead
+within noise; docs/OBSERVABILITY.md).
+
+`observe_report()` combines the beat's latest state with the tracer's
+critical-path analysis (kernel/tracing.py) into the one dict served by
+`GET /api/instance/observe`, rendered by `swx top`, and stamped into
+bench artifacts as the `observe` block.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Optional
+
+from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+
+logger = logging.getLogger(__name__)
+
+
+class TelemetryBeat(BackgroundTaskComponent):
+    """The always-on sampler loop (child of the ServiceRuntime)."""
+
+    def __init__(self, runtime, interval_s: Optional[float] = None,
+                 ring: int = 0, stall_s: Optional[float] = None):
+        super().__init__("telemetry-beat")
+        self.runtime = runtime
+        settings = runtime.settings
+        self.interval_s = (interval_s if interval_s is not None
+                           else getattr(settings, "observe_interval_ms",
+                                        250.0) / 1e3)
+        self.stall_s = (stall_s if stall_s is not None
+                        else getattr(settings, "observe_stall_ms",
+                                     100.0) / 1e3)
+        self.samples: deque[dict] = deque(
+            maxlen=ring or getattr(settings, "observe_ring", 256))
+        metrics = runtime.metrics
+        self.beats = metrics.counter("observe.beats")
+        self.stalls = metrics.counter("observe.loop_stalls")
+        self.loop_lag = metrics.histogram(
+            "observe.loop_lag_s",
+            # lag lives in the 0.1 ms – 13 s band; the default 10 µs-up
+            # ladder wastes half its buckets below scheduler resolution
+            buckets=[1e-4 * (2 ** i) for i in range(17)])
+        self.lag_gauge = metrics.gauge("observe.consumer_lag")
+        self.backlog_gauge = metrics.gauge("observe.egress_backlog")
+        self.pending_gauge = metrics.gauge("observe.scoring_pending")
+        self.inflight_gauge = metrics.gauge("observe.scoring_inflight")
+        # per-suffix gauge keys seen on the previous beat: a group or
+        # tenant that disappears must have its gauge zeroed, not left
+        # reporting its last backlog forever
+        self._lag_groups: set[str] = set()
+        self._egress_tenants: set[str] = set()
+
+    async def _run(self) -> None:
+        import asyncio
+
+        runtime = self.runtime
+        interval = max(self.interval_s, 0.01)
+        next_t = time.monotonic() + interval
+        while True:
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            # the probe itself: we asked to run at next_t; the gap is
+            # time the event loop spent NOT yielding to ready callbacks
+            # — a blocked loop (sync compile, spin, starvation) surfaces
+            # here within one beat. Measured BEFORE the chaos consult:
+            # a delay-mode observe.beat fault must suspend the beat, not
+            # masquerade as event-loop lag.
+            lag = max(time.monotonic() - next_t, 0.0)
+            if runtime.faults is not None:
+                # chaos seam: a crashed beat must restart under the
+                # supervisor like any service loop (acheck — a
+                # delay-mode fault suspends this coroutine, not the loop
+                # it exists to watch)
+                await runtime.faults.acheck("observe.beat")
+            self.sample(loop_lag_s=lag)
+            # re-anchor after a stall: chasing missed beats would burst
+            # N catch-up samples that all measure the same stall
+            next_t = max(next_t + interval,
+                         time.monotonic() + 0.2 * interval)
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self, loop_lag_s: float = 0.0) -> dict:
+        """Take one sample NOW (the beat loop's tick; tests call it
+        directly). Synchronous on purpose — no await may separate the
+        signals inside one sample."""
+        runtime = self.runtime
+        self.beats.inc()
+        self.loop_lag.observe(loop_lag_s)
+        if loop_lag_s >= self.stall_s:
+            self.stalls.inc()
+            logger.warning(
+                "telemetry-beat: event loop lagged %.1f ms (stall "
+                "threshold %.1f ms) — a consumer loop is not yielding",
+                loop_lag_s * 1e3, self.stall_s * 1e3)
+        metrics = runtime.metrics
+        # consumer lag: committed offset vs head, per group (in-proc bus
+        # only; a wire-bus process reads lag on the broker process)
+        lags: dict[str, int] = {}
+        group_lags = getattr(runtime.bus, "group_lags", None)
+        if group_lags is not None:
+            for group, by_topic in group_lags().items():
+                total = sum(by_topic.values())
+                lags[group] = total
+                metrics.gauge(f"observe.consumer_lag:{group}").set(total)
+        for gone in self._lag_groups - set(lags):
+            metrics.gauge(f"observe.consumer_lag:{gone}").set(0)
+        self._lag_groups = set(lags)
+        lag_max = max(lags.values(), default=0)
+        self.lag_gauge.set(lag_max)
+        # egress backlog + scoring occupancy per rule-processing engine
+        egress: dict[str, int] = {}
+        scoring: dict[str, dict] = {}
+        rp = runtime.services.get("rule-processing")
+        if rp is not None:
+            for tid, eng in rp.engines.items():
+                stage = getattr(eng, "egress", None)
+                if stage is not None:
+                    egress[tid] = stage.backlog
+                    metrics.gauge(f"observe.egress_backlog:{tid}").set(
+                        stage.backlog)
+                sink = getattr(eng, "session", None) \
+                    or getattr(eng, "pool_slot", None)
+                if sink is not None:
+                    scoring[tid] = {"pending": sink.pending_n,
+                                    "inflight": getattr(sink, "inflight",
+                                                        0)}
+        for gone in self._egress_tenants - set(egress):
+            metrics.gauge(f"observe.egress_backlog:{gone}").set(0)
+        self._egress_tenants = set(egress)
+        self.backlog_gauge.set(sum(egress.values()))
+        self.pending_gauge.set(sum(s["pending"] for s in scoring.values()))
+        self.inflight_gauge.set(
+            sum(s["inflight"] for s in scoring.values()))
+        # flow mode + pressure per tenant (the shed ladder's live state)
+        flow = getattr(runtime, "flow", None)
+        modes = flow.modes() if flow is not None else {}
+        sample = {
+            "t": time.time(),
+            "loop_lag_ms": round(loop_lag_s * 1e3, 3),
+            "consumer_lag": lags,
+            "consumer_lag_max": lag_max,
+            "egress_backlog": egress,
+            "scoring": scoring,
+            "flow": modes,
+        }
+        self.samples.append(sample)
+        return sample
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The beat's aggregate view: loop-lag quantiles, stall count,
+        and the latest sample (None when no beat has fired yet)."""
+        last = self.samples[-1] if self.samples else None
+        return {
+            "interval_ms": round(self.interval_s * 1e3, 1),
+            "stall_threshold_ms": round(self.stall_s * 1e3, 1),
+            "beats": int(self.beats.value),
+            "loop_stalls": int(self.stalls.value),
+            "loop_lag_ms": {
+                "p50": round(self.loop_lag.quantile(0.50) * 1e3, 3),
+                "p99": round(self.loop_lag.quantile(0.99) * 1e3, 3),
+                "max": round(self.loop_lag._max * 1e3, 3),
+            },
+            "consumer_lag_max": (last or {}).get("consumer_lag_max", 0),
+            "ring": len(self.samples),
+            "last": last,
+        }
+
+
+def observe_report(runtime, tenant: Optional[str] = None) -> dict:
+    """The flight recorder's one-call report: critical path over sampled
+    traces + the telemetry beat's live state. Served by
+    `GET /api/instance/observe`, rendered by `swx top`, stamped into
+    bench artifacts."""
+    beat = getattr(runtime, "beat", None)
+    return {
+        "critical_path": runtime.tracer.critical_path(tenant=tenant),
+        "beat": beat.snapshot() if beat is not None else None,
+    }

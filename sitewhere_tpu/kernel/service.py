@@ -77,7 +77,8 @@ class TenantEngine(LifecycleComponent):
         self.runtime.flow.note_dead_letter(self.tenant_id)
         await quarantine(self.runtime.bus, self.dead_letter_topic, record,
                          exc, stage, metrics=self.runtime.metrics,
-                         tenant_id=self.tenant_id)
+                         tenant_id=self.tenant_id,
+                         tracer=self.runtime.tracer)
 
 
 class Service(LifecycleComponent):
@@ -256,6 +257,17 @@ class ServiceRuntime(LifecycleComponent):
         # the rule-processing shed path consult this
         from sitewhere_tpu.kernel.flow import FlowController
         self.flow = FlowController(settings, self.metrics)
+        # pipeline flight recorder (kernel/observe.py): the always-on
+        # telemetry beat — event-loop lag probe, consumer-group lag,
+        # egress backlog, scoring occupancy, flow mode — sampled into a
+        # bounded ring + the metrics registry. A lifecycle child, so it
+        # rides the runtime's start/stop and the supervisor's restart
+        # budget like every service loop.
+        self.beat = None
+        if getattr(settings, "observe_enabled", True):
+            from sitewhere_tpu.kernel.observe import TelemetryBeat
+            self.beat = TelemetryBeat(self)
+            self.add_child(self.beat)
         self.services: dict[str, Service] = {}
         self.remotes: dict[str, Any] = {}   # identifier -> RemoteService
         self.tenants: dict[str, TenantConfig] = {}

@@ -1,20 +1,33 @@
-"""Per-event pipeline tracing [SURVEY.md §5.1].
+"""Per-event pipeline tracing [SURVEY.md §5.1] — the trace spine of the
+pipeline flight recorder.
 
 The reference has no distributed tracing in core (logging only); the
 rebuild carries a trace context in every batch envelope
 (`BatchContext.trace_id`, stamped at the receiver) and records one SPAN
-per pipeline stage into a bounded in-memory ring:
+per pipeline stage into bounded per-stage rings:
 
-    receiver → decode → enrich → persist → score → deliver
+    receiver → decode → enrich → persist → dispatch → score → egress.publish
+
+plus the off-ramp stages (deferred spool/replay, DLQ quarantine/replay).
+The stage inventory lives in `analysis/registry.py` (`TRACE_STAGES`) —
+swxlint TRC01 resolves every recorded stage literal against it, exactly
+as MET01 does for metric names — and each stage is classified as
+*queue* (time spent waiting: receiver arrival → decode, admission →
+dispatch) or *service* (time spent working), so the critical-path
+report can answer "where does paced p99 live" with a queue-wait vs
+service-time split.
 
 Sampling keeps the hot path honest: at 1M events/s nobody can afford a
 span per batch per stage, so only every `sample`-th trace id records
-(trace ids are dense counters, so modulo sampling is uniform). The
-model plane's profiler story is `jax.profiler` (bench.py --profile).
+(trace ids are dense counters, so modulo sampling is uniform). Spans
+ring per STAGE (one chatty stage — a busy egress shard, a flapping DLQ
+— can no longer evict every other stage's spans from a shared ring).
+The model plane's profiler story is `jax.profiler` (bench.py --profile).
 
 `Tracer.spans()` / `Tracer.trace(trace_id)` are the query surface (REST
-exposes them); `record()` is the single write path (kept lean: the hot
-pipeline calls it per batch per stage).
+exposes them, with tenant filtering and pagination); `record()` is the
+single write path (kept lean: the hot pipeline calls it per batch per
+stage).
 """
 
 from __future__ import annotations
@@ -22,7 +35,9 @@ from __future__ import annotations
 import itertools
 from collections import deque
 from dataclasses import dataclass
-from typing import Optional
+from typing import Iterable, Optional
+
+from sitewhere_tpu.kernel.metrics import Histogram
 
 
 @dataclass(frozen=True, slots=True)
@@ -42,11 +57,18 @@ class Span:
 
 
 class Tracer:
-    """Bounded span ring with modulo sampling. One per runtime."""
+    """Bounded per-stage span rings with modulo sampling. One per
+    runtime. `capacity` is the total span budget; each stage's ring gets
+    `stage_capacity` (default `capacity // 8`, min 64) so stages evict
+    only their own history."""
 
-    def __init__(self, capacity: int = 4096, sample: int = 64):
+    def __init__(self, capacity: int = 4096, sample: int = 64,
+                 stage_capacity: int = 0):
         self.sample = max(int(sample), 1)
-        self._spans: deque[Span] = deque(maxlen=capacity)
+        self.stage_capacity = (max(int(stage_capacity), 1)
+                               if stage_capacity
+                               else max(capacity // 8, 64))
+        self._rings: dict[str, deque[Span]] = {}
         self._ids = itertools.count(1)
 
     def new_trace_id(self) -> int:
@@ -58,34 +80,115 @@ class Tracer:
 
     def record(self, trace_id: int, stage: str, tenant_id: str,
                t_start: float, duration_s: float, n_events: int = 0) -> None:
-        if self.sampled(trace_id):
-            self._spans.append(Span(trace_id, stage, tenant_id, t_start,
-                                    duration_s, n_events))
+        if not self.sampled(trace_id):
+            return
+        ring = self._rings.get(stage)
+        if ring is None:
+            ring = self._rings[stage] = deque(maxlen=self.stage_capacity)
+        ring.append(Span(trace_id, stage, tenant_id, t_start,
+                         duration_s, n_events))
 
     # -- query surface -----------------------------------------------------
 
-    def spans(self, stage: Optional[str] = None,
-              limit: int = 256) -> list[Span]:
-        out = [s for s in reversed(self._spans)
-               if stage is None or s.stage == stage]
-        return out[:limit]
+    def _all(self) -> Iterable[Span]:
+        for ring in self._rings.values():
+            yield from ring
 
-    def trace(self, trace_id: int) -> list[Span]:
+    def stages(self) -> list[str]:
+        return sorted(self._rings)
+
+    def spans(self, stage: Optional[str] = None,
+              tenant: Optional[str] = None,
+              limit: int = 256, offset: int = 0) -> list[Span]:
+        """Newest-first span listing, filterable by stage and tenant,
+        paginated with (offset, limit) — the REST listing surface."""
+        if stage is not None:
+            source: Iterable[Span] = self._rings.get(stage, ())
+        else:
+            source = self._all()
+        out = [s for s in source
+               if tenant is None or s.tenant_id == tenant]
+        out.sort(key=lambda s: s.t_start, reverse=True)
+        if offset:
+            out = out[offset:]
+        return out[:limit] if limit >= 0 else out
+
+    def trace(self, trace_id: int,
+              tenant: Optional[str] = None) -> list[Span]:
         """Every recorded span of one trace, in time order — the
-        pipeline's journey for one ingest batch."""
-        return sorted((s for s in self._spans if s.trace_id == trace_id),
+        pipeline's journey for one ingest batch, receiver →
+        egress.publish (plus any off-ramp spans it took)."""
+        return sorted((s for s in self._all()
+                       if s.trace_id == trace_id
+                       and (tenant is None or s.tenant_id == tenant)),
                       key=lambda s: s.t_start)
 
-    def stage_summary(self) -> dict[str, dict]:
-        """Mean/max duration + event counts per stage (ops dashboard)."""
-        agg: dict[str, list[Span]] = {}
-        for s in self._spans:
-            agg.setdefault(s.stage, []).append(s)
+    def _stage_hist(self, spans: Iterable[Span]) -> tuple[Histogram, int,
+                                                          int, float]:
+        hist = Histogram("stage")
+        events = 0
+        count = 0
+        total = 0.0
+        for s in spans:
+            hist.observe(s.duration_s)
+            events += s.n_events
+            count += 1
+            total += s.duration_s
+        return hist, count, events, total
+
+    def stage_summary(self, tenant: Optional[str] = None) -> dict[str, dict]:
+        """Per-stage p50/p95/p99 duration + event counts over the
+        sampled spans (ops dashboard; quantiles via the same
+        `Histogram.quantile` the metrics registry uses — the old
+        mean/max pair hid exactly the tail this exists to show)."""
+        out: dict[str, dict] = {}
+        for stage in sorted(self._rings):
+            spans = [s for s in self._rings[stage]
+                     if tenant is None or s.tenant_id == tenant]
+            if not spans:
+                continue
+            hist, count, events, total = self._stage_hist(spans)
+            out[stage] = {
+                "count": count,
+                "p50_ms": round(hist.quantile(0.50) * 1e3, 3),
+                "p95_ms": round(hist.quantile(0.95) * 1e3, 3),
+                "p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+                "mean_ms": round(total / count * 1e3, 3),
+                "max_ms": round(hist._max * 1e3, 3),
+                "events": events,
+            }
+        return out
+
+    def critical_path(self, tenant: Optional[str] = None) -> dict:
+        """The critical-path report over sampled traces: per-stage
+        quantiles in pipeline order, each stage classified queue vs
+        service (analysis/registry.py TRACE_STAGES), and the queue-wait
+        vs service-time p99 split — "where does paced p99 live".
+
+        Unregistered stages (tests, future drift) still report, with
+        kind "unknown"; TRC01 is the gate that keeps the live tree's
+        stages registered."""
+        from sitewhere_tpu.analysis.registry import TRACE_STAGES
+
+        kinds = dict(TRACE_STAGES)
+        order = {name: i for i, (name, _) in enumerate(TRACE_STAGES)}
+        summary = self.stage_summary(tenant=tenant)
+        stages: dict[str, dict] = {}
+        queue_p99 = service_p99 = 0.0
+        span_count = 0
+        for stage in sorted(summary, key=lambda s: order.get(s, 1000)):
+            kind = kinds.get(stage, "unknown")
+            row = {**summary[stage], "kind": kind}
+            stages[stage] = row
+            span_count += row["count"]
+            if kind == "queue":
+                queue_p99 += row["p99_ms"]
+            elif kind == "service":
+                service_p99 += row["p99_ms"]
         return {
-            stage: {
-                "count": len(ss),
-                "mean_ms": round(sum(x.duration_s for x in ss) / len(ss) * 1e3, 3),
-                "max_ms": round(max(x.duration_s for x in ss) * 1e3, 3),
-                "events": sum(x.n_events for x in ss),
-            } for stage, ss in agg.items()
+            "stages": stages,
+            "span_count": span_count,
+            "queue_wait_p99_ms": round(queue_p99, 3),
+            "service_p99_ms": round(service_p99, 3),
+            "sample": self.sample,
         }

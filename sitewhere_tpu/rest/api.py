@@ -327,8 +327,16 @@ class RestServer(LifecycleComponent):
         r("GET", r"/api/openapi\.json", self.get_openapi, authority=None)
         r("GET", r"/api/instance/health", self.get_health, authority=None)
         r("GET", r"/api/instance/metrics", self.get_metrics)
+        # Prometheus exposition off the existing registry (the beat's
+        # observe.* gauges/histograms ride it with zero new plumbing)
+        r("GET", r"/api/instance/metrics/prometheus",
+          self.get_metrics_prometheus)
         r("GET", r"/api/instance/topics", self.get_topics)
-        # pipeline tracing [SURVEY.md §5.1]
+        # pipeline flight recorder (kernel/observe.py): critical path +
+        # telemetry beat, the `swx top` data source
+        r("GET", r"/api/instance/observe", self.get_observe)
+        # pipeline tracing [SURVEY.md §5.1]; all three accept ?tenant=
+        # and the listing endpoints paginate with ?limit=&offset=
         r("GET", r"/api/instance/traces", self.get_trace_summary)
         r("GET", r"/api/instance/traces/spans", self.get_trace_spans)
         r("GET", r"/api/instance/traces/(?P<id>\d+)", self.get_trace)
@@ -495,16 +503,34 @@ class RestServer(LifecycleComponent):
     async def get_metrics(self, req: Request):
         return self.runtime.metrics.snapshot()
 
+    async def get_metrics_prometheus(self, req: Request):
+        """The metrics registry in Prometheus exposition format (the
+        text a scraper reads; kernel/metrics.py prometheus_text)."""
+        return ("text/plain; version=0.0.4",
+                self.runtime.metrics.prometheus_text().encode())
+
+    async def get_observe(self, req: Request):
+        """Flight-recorder report: critical path over sampled traces
+        (queue-wait vs service split) + the telemetry beat's live
+        state (loop lag, consumer lag, backlog, flow modes)."""
+        from sitewhere_tpu.kernel.observe import observe_report
+
+        return observe_report(self.runtime, tenant=req.qp("tenant"))
+
     async def get_trace_summary(self, req: Request):
-        return self.runtime.tracer.stage_summary()
+        return self.runtime.tracer.stage_summary(tenant=req.qp("tenant"))
 
     async def get_trace_spans(self, req: Request):
         spans = self.runtime.tracer.spans(
-            stage=req.qp("stage"), limit=req.int_qp("limit", 256))
-        return {"spans": [s.to_dict() for s in spans]}
+            stage=req.qp("stage"), tenant=req.qp("tenant"),
+            limit=req.int_qp("limit", 256),
+            offset=req.int_qp("offset", 0))
+        return {"spans": [s.to_dict() for s in spans],
+                "offset": req.int_qp("offset", 0)}
 
     async def get_trace(self, req: Request):
-        spans = self.runtime.tracer.trace(int(req.params["id"]))
+        spans = self.runtime.tracer.trace(int(req.params["id"]),
+                                          tenant=req.qp("tenant"))
         return {"trace_id": int(req.params["id"]),
                 "spans": [s.to_dict() for s in spans]}
 
@@ -756,6 +782,17 @@ class RestServer(LifecycleComponent):
             batch = build(idx, b, tenant_id)
         except (TypeError, ValueError) as exc:
             raise HttpError(400, f"bad event payload: {exc}") from exc
+        # REST is a receiver edge like any other: stamp a trace id and
+        # record the spine's first span so a sampled cold-path event is
+        # traceable receiver → egress.publish like gateway traffic
+        import time as _time
+
+        tracer = self.runtime.tracer
+        batch.ctx.trace_id = tracer.new_trace_id()
+        tracer.record(batch.ctx.trace_id, "event-sources.receive",
+                      tenant_id, batch.ctx.ingest_monotonic,
+                      max(_time.monotonic() - batch.ctx.ingest_monotonic,
+                          0.0), len(batch))
         sources = self._engine(req, "event-sources")
         await self.runtime.bus.produce(
             sources.tenant_topic(TopicNaming.EVENT_SOURCE_DECODED), batch,
@@ -1002,7 +1039,7 @@ class RestServer(LifecycleComponent):
         n = await replay_dead_letters(
             self.runtime.bus, self._dlq_topic(req), limit=limit,
             metrics=self.runtime.metrics, flow=self.runtime.flow,
-            tenant_id=self._tenant_id(req))
+            tenant_id=self._tenant_id(req), tracer=self.runtime.tracer)
         return {"replayed": n}
 
     # -- handlers: areas/customers/zones/assets ----------------------------

@@ -625,14 +625,14 @@ class SharedScoringPool:
                 if n <= budget:
                     e.pending.pop(0)
                     taken.append(p)
-                    traces.append((p[4].trace_id, n))
+                    traces.append((p[4].trace_id, n, p[5]))
                     budget -= n
                 else:
                     head = tuple(c[:budget] for c in p[:4]) + (p[4], p[5])
                     e.pending[0] = tuple(c[budget:] for c in p[:4]) \
                         + (p[4], p[5])
                     taken.append(head)
-                    traces.append((p[4].trace_id, budget))
+                    traces.append((p[4].trace_id, budget, p[5]))
                     budget = 0
                 self.stage_batch.observe(now - p[5])
             e.pending_n = sum(p[0].shape[0] for p in e.pending)
@@ -711,6 +711,17 @@ class SharedScoringPool:
         self.dispatches.inc(len(dispatches))
         self.megabatch_dispatches.inc(len(dispatches))
         self.megabatch_tenants.observe(float(len(metas)))
+        if self.tracer is not None:
+            # dispatch/settle split with megabatch tenant attribution:
+            # every packed tenant's traces get a queue-wait span here
+            # (its own admit time → this stacked dispatch) and the
+            # settle records the shared device half per tenant below
+            for tid, _slot, _n, _dev, _ts, _ing, traces, *_ in metas:
+                for trace_id, n_ev, t_admit in traces:
+                    self.tracer.record(trace_id,
+                                       "rule-processing.dispatch", tid,
+                                       t_admit, max(t0 - t_admit, 0.0),
+                                       n_ev)
         self.inflight += 1
         seq = self.dispatch_count
         self.dispatch_count += 1
@@ -798,7 +809,7 @@ class SharedScoringPool:
                         ctx, dev, scores, is_anom, ts,
                         model_version=version)
                 if self.tracer is not None:
-                    for trace_id, n_ev in traces:
+                    for trace_id, n_ev, *_ in traces:
                         self.tracer.record(trace_id, "rule-processing.score",
                                            tid, t0, now - t0, n_ev)
                 deliveries.append((tid, e.deliver, scored))

@@ -426,9 +426,9 @@ class ScoringSession:
             # with NO copies — np.concatenate of a 1-element list
             # memcpys every column, ~0.4 MB per 4096-event flush on
             # the hot path for nothing
-            dev, val, ts, ingest, ctx, _ = pending[0]
+            dev, val, ts, ingest, ctx, t_admit = pending[0]
             return (dev, val.astype(np.float32, copy=False), ts, ingest,
-                    ctx, [(ctx.trace_id, dev.shape[0])])
+                    ctx, [(ctx.trace_id, dev.shape[0], t_admit)])
         dev = np.concatenate([p[0] for p in pending])
         val = np.concatenate([p[1] for p in pending]).astype(np.float32, copy=False)
         ts = np.concatenate([p[2] for p in pending])
@@ -437,9 +437,11 @@ class ScoringSession:
         ctx = pending[0][4] if len(sources) == 1 else BatchContext(
             tenant_id=pending[0][4].tenant_id, source="+".join(sorted(sources)),
             ingest_monotonic=min(p[4].ingest_monotonic for p in pending))
-        # every admitted batch's trace gets its own score span (a flush
-        # coalesces many traces; attributing all to one hides the rest)
-        traces = [(p[4].trace_id, p[0].shape[0]) for p in pending]
+        # every admitted batch's trace gets its own dispatch/score span
+        # pair (a flush coalesces many traces; attributing all to one
+        # hides the rest) — admit time rides along so the dispatch span
+        # measures THAT batch's queue wait, not the flush's
+        traces = [(p[4].trace_id, p[0].shape[0], p[5]) for p in pending]
         return dev, val, ts, ingest, ctx, traces
 
     def _dispatch(self, dev, val):
@@ -568,8 +570,8 @@ class ScoringSession:
                 scored = ScoredBatch(ctx, dev, scores, is_anom, ts,
                                      model_version=self.version)
             if self.tracer is not None:
-                for trace_id, n_ev in (traces or [(ctx.trace_id,
-                                                   dev.shape[0])]):
+                for trace_id, n_ev, *_ in (traces or [(ctx.trace_id,
+                                                       dev.shape[0])]):
                     self.tracer.record(trace_id, "rule-processing.score",
                                        ctx.tenant_id, t0, now - t0, n_ev)
             if fut is not None and not fut.done():
@@ -594,6 +596,15 @@ class ScoringSession:
         arrival order across chunks. Returns chunks dispatched."""
         loop = asyncio.get_running_loop()
         max_b = self.cfg.buckets[-1]
+        if self.tracer is not None and traces:
+            # the dispatch/settle split: this span is pure QUEUE WAIT
+            # (admission → jit dispatch: batching window + inflight
+            # gate); the settle records "rule-processing.score" for the
+            # device half (dispatch → scores on host)
+            for trace_id, n_ev, t_admit in traces:
+                self.tracer.record(trace_id, "rule-processing.dispatch",
+                                   ctx.tenant_id, t_admit,
+                                   max(t0 - t_admit, 0.0), n_ev)
         n_chunks = 0
         for lo in range(0, dev.shape[0], max_b):
             hi = lo + max_b

@@ -772,9 +772,16 @@ class EventSourcesEngine(TenantEngine):
                 self._failed_topic, {"payload": payload, "error": repr(exc),
                                      "source": source})
             return
+        n_decoded = sum(len(b) for b in batches)
+        # the spine's first span: receiver arrival (ingest_monotonic,
+        # stamped at the socket/queue edge) → decode start — pure queue
+        # wait at the receiving edge, zero when the receiver decodes
+        # inline
+        tracer.record(ctx.trace_id, "event-sources.receive",
+                      self.tenant_id, ctx.ingest_monotonic,
+                      max(t0 - ctx.ingest_monotonic, 0.0), n_decoded)
         tracer.record(ctx.trace_id, "event-sources.decode", self.tenant_id,
-                      t0, time.monotonic() - t0,
-                      sum(len(b) for b in batches))
+                      t0, time.monotonic() - t0, n_decoded)
         for batch in batches:
             n = len(batch)
             if n:

@@ -285,9 +285,16 @@ class RuleProcessingEngine(TenantEngine):
             # retention trims them — degrade instead
             shed = "degrade"
         if shed == "defer":
+            t0 = time.monotonic()
             await self.runtime.bus.produce(
                 self.tenant_topic(TopicNaming.DEFERRED_EVENTS), batch,
                 key=key)
+            # the deferred off-ramp is part of the event's journey: a
+            # sampled trace shows WHERE it left the scored path (and
+            # "flow.replay" later shows it coming back)
+            self.runtime.tracer.record(
+                batch.ctx.trace_id, "flow.defer", self.tenant_id,
+                t0, time.monotonic() - t0, len(batch))
             flow.count_shed(self.tenant_id, "defer", len(batch))
         elif shed == "degrade":
             scored = self.degraded_score(batch)
@@ -302,9 +309,15 @@ class RuleProcessingEngine(TenantEngine):
         the settle path. The fused default routes through the
         EgressStage instead (kernel/egresslane.py), which publishes and
         emits alerts on supervised shard loops off the flush path."""
+        t0 = time.monotonic()
         await self.runtime.bus.produce(
             self.tenant_topic(TopicNaming.SCORED_EVENTS), scored,
             key=scored.ctx.source)
+        # same stage name as the fused EgressStage records: traces stay
+        # comparable across the inline and fused egress configurations
+        self.runtime.tracer.record(
+            scored.ctx.trace_id, "egress.publish", self.tenant_id,
+            t0, time.monotonic() - t0, len(scored))
         if self.emit_alerts and scored.is_anomaly.any():
             em = self.runtime.api("event-management").management(self.tenant_id)
             em.add_alert_batch(anomaly_alerts(scored, self.model_name))
@@ -579,7 +592,15 @@ class RuleProcessor(BackgroundTaskComponent):
                         try:
                             if not isinstance(rec.value, MeasurementBatch):
                                 continue
+                            t_rep = time.monotonic()
                             sink.admit(rec.value)
+                            # spool → re-admission: the gap between the
+                            # "flow.defer" span and this one's t_start
+                            # is the time the batch sat deferred
+                            runtime.tracer.record(
+                                rec.value.ctx.trace_id, "flow.replay",
+                                tenant_id, t_rep,
+                                time.monotonic() - t_rep, len(rec.value))
                             flow.count("deferred_replayed", tenant_id,
                                        len(rec.value))
                         except asyncio.CancelledError:

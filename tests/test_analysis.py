@@ -114,7 +114,9 @@ def test_flw01_produce_without_consult_in_rest_module():
             async def ingest(self, req):
                 await self.runtime.bus.produce("topic", req.json())
     """, path="sitewhere_tpu/rest/api.py")
-    assert _codes(rep) == ["FLW01"]
+    # rest/api.py is under BOTH contracts: an unconsulted produce is an
+    # FLW01, and a span-less hot-path produce is a TRC01 (tracing parity)
+    assert _codes(rep) == ["FLW01", "TRC01"]
 
 
 def test_flw01_negative_with_admit_on_same_path():

@@ -376,7 +376,7 @@ def test_single_admit_flush_is_zero_copy(run):
         assert val is batch.value
         assert ts is batch.ts
         assert ctx is batch.ctx
-        assert traces == [(ctx.trace_id, 64)]
+        assert [(t[0], t[1]) for t in traces] == [(ctx.trace_id, 64)]
         assert session.pending_n == 0
 
     run(main())

@@ -201,12 +201,13 @@ def test_pipeline_spans_recorded(run):
                           "event-management.persist", "rule-processing.score"):
                 assert stage in summary, (stage, summary.keys())
                 assert summary[stage]["events"] > 0
-            # one trace's journey is ordered decode → ... → score
+            # one trace's journey is ordered receive → decode → ... → score
             scored = [s for s in rt.tracer.spans("rule-processing.score")
                       if s.n_events > 0]
             journey = rt.tracer.trace(scored[0].trace_id)
             stages = [s.stage for s in journey]
-            assert stages.index("event-sources.decode") == 0
+            assert stages.index("event-sources.receive") == 0
+            assert stages.index("event-sources.decode") == 1
             assert "event-management.persist" in stages
 
     run(main())

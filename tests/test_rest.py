@@ -334,8 +334,9 @@ def test_rest_trace_endpoints(run):
             status, journey = await http(
                 port, "GET", f"/api/instance/traces/{tid}", token=tok)
             assert status == 200
-            assert [s["stage"] for s in journey["spans"]][0] == \
-                "event-sources.decode"
+            stages = [s["stage"] for s in journey["spans"]]
+            assert stages[0] == "event-sources.receive"
+            assert stages[1] == "event-sources.decode"
 
     run(main())
 
