@@ -23,6 +23,11 @@ Presets (the levers bench.py exposes):
     observe   on = pipeline flight recorder (telemetry beat + trace
               spine, default), off = `--no-observe` — the paired
               overhead run (acceptance: saturation median within 3%)
+    fleet     a = `--workers N` (fleet deployment: shared bus tier +
+              N worker processes + controller, with the scripted
+              worker-kill drill), b = `--workers 1` — the scale-out
+              A/B; the table compares aggregate scored-events/s and
+              the kill drill's zero-loss accounting
 
 Usage:
 
@@ -79,6 +84,40 @@ def ratio(a: float, b: float) -> str:
         return "—"
     r = a / b
     return f"{r - 1:+.0%}" if 0.1 < r < 10 else f"{r:.2f}×"
+
+
+def fleet_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
+    """Fleet-preset table: scale-out throughput + kill-drill columns
+    (the fleet artifact has no cross-process e2e latency — monotonic
+    stamps don't compose over the process boundary)."""
+    fa, fb = a.get("fleet") or {}, b.get("fleet") or {}
+    rows = [
+        ("workers", str(fb.get("workers")), str(fa.get("workers")), ""),
+        ("aggregate sat median (ev/s)",
+         f"{b['value_median']:,.0f}", f"{a['value_median']:,.0f}",
+         ratio(a["value_median"], b["value_median"])),
+        ("aggregate sat best (ev/s)",
+         f"{b['value']:,.0f}", f"{a['value']:,.0f}",
+         ratio(a["value"], b["value"])),
+        ("tenants", str(fb.get("tenants")), str(fa.get("tenants")), ""),
+        ("rebalances / final epoch",
+         f"{fb.get('rebalances')} / {fb.get('epoch')}",
+         f"{fa.get('rebalances')} / {fa.get('epoch')}", ""),
+    ]
+    for name, art in ((name_b, fb), (name_a, fa)):
+        kill = art.get("kill")
+        if kill:
+            rows.append((
+                f"kill drill ({name})",
+                "", f"killed {kill.get('killed_worker')}, "
+                    f"lost {kill.get('lost_accepted_events')} of "
+                    f"{kill.get('accepted_events')} accepted, "
+                    f"reconverged {kill.get('converged_after_kill_s')}s, "
+                    f"replacement={kill.get('replacement_spawned')}", ""))
+    out = [f"| metric | {name_b} | {name_a} | Δ (A vs B) |",
+           "|---|---|---|---|"]
+    out += [f"| {m} | {vb} | {va} | {d} |" for m, vb, va, d in rows]
+    return "\n".join(out)
 
 
 def delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
@@ -147,7 +186,12 @@ def main() -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
-                                           "megabatch", "observe"])
+                                           "megabatch", "observe",
+                                           "fleet"])
+    parser.add_argument("--workers", type=int, default=2,
+                        help="worker-process count for the fleet "
+                             "preset's scale-out leg (the other leg "
+                             "runs --workers 1)")
     parser.add_argument("--lanes", type=int, default=2,
                         help="egress/consumer lane count for the sharded "
                              "run (egress + lanes presets)")
@@ -182,6 +226,11 @@ def main() -> int:
     elif args.preset == "observe":
         pairs = [("off", ["--no-observe"]), ("on", [])]
         names = ("observe off", "observe on")
+    elif args.preset == "fleet":
+        w = str(args.workers)
+        pairs = [("w1", ["--workers", "1"]),
+                 (f"w{w}", ["--workers", w])]
+        names = ("fleet workers=1", f"fleet workers={w}")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",
@@ -198,8 +247,11 @@ def main() -> int:
         print(f"[ab_compare] wrote {path}", file=sys.stderr)
         artifacts.append(artifact)
 
-    b, a = artifacts  # baseline ran first (off / lanes1)
-    print(delta_table(names[1], a, names[0], b))
+    b, a = artifacts  # baseline ran first (off / lanes1 / w1)
+    if args.preset == "fleet":
+        print(fleet_delta_table(names[1], a, names[0], b))
+    else:
+        print(delta_table(names[1], a, names[0], b))
     return 0
 
 

@@ -39,6 +39,8 @@ FAULT_SITES = frozenset({
     "flow.admit",         # kernel/flow.py ingress admission
     "flow.shed",          # kernel/flow.py shed-mode consult
     "observe.beat",       # kernel/observe.py telemetry-beat sampler tick
+    "fleet.heartbeat",    # fleet/worker.py heartbeat publish
+    "fleet.rebalance",    # fleet/controller.py placement publish
 })
 
 # -- trace stages (kernel/tracing.py spans; TRC01 resolves literals) ---------
@@ -121,6 +123,14 @@ COUNTERS = (
     # flight recorder (kernel/observe.py)
     "observe.beats",
     "observe.loop_stalls",
+    # fleet control plane (sitewhere_tpu/fleet)
+    "fleet.heartbeats",
+    "fleet.rebalances",
+    "fleet.releases",
+    "fleet.handoffs",
+    "fleet.worker_deaths",
+    "fleet.autoscale_up",
+    "fleet.autoscale_down",
 )
 
 GAUGES = (
@@ -132,6 +142,10 @@ GAUGES = (
     "observe.egress_backlog",
     "observe.scoring_pending",
     "observe.scoring_inflight",
+    # fleet control plane (sitewhere_tpu/fleet)
+    "fleet.workers_live",
+    "fleet.placement_epoch",
+    "fleet.tenants_pending",
 )
 
 METERS = (
@@ -156,6 +170,8 @@ HISTOGRAMS = (
     "scoring.megabatch_tenants_per_dispatch",
     # flight recorder (kernel/observe.py): event-loop lag per beat
     "observe.loop_lag_s",
+    # fleet: placement-seen → engines-adopted per tenant move
+    "fleet.handoff_s",
 )
 
 # f-string metric names whose suffix is computed at runtime

@@ -10,6 +10,8 @@ rebuild ships one:
   swx dlq list|replay --tenant T                   inspect/replay dead letters
   swx quota show|set --tenant T                    flow-control quotas
   swx top [--interval S] [--once]                  live flight-recorder view
+  swx fleet status                                 fleet placement/liveness view
+  swx fleet-worker --bus H:P --worker-id W         run one fleet worker
   swx lint [--format json]                         static invariant checks
 
 `run` starts every service, creates tenants from the YAML (or a default
@@ -85,7 +87,7 @@ _WIRE_AWARE_REMOTES = {"device-management"}
 _REMOTE_CONSUMERS = {"device-management": {"inbound-processing"}}
 
 
-def _validate_split(services, remotes):
+def _validate_split(services, remotes, fleet_controller=False):
     if services is None:
         if remotes:
             # no --services = EVERY service hosted locally, so any
@@ -98,6 +100,13 @@ def _validate_split(services, remotes):
         return
     for name in services:
         need = _COLOCATE.get(name, set())
+        if fleet_controller and name == "instance-management":
+            # a fleet-controller host serves /api/jwt, tenant CRUD, and
+            # /api/fleet — the engine-touching routes 404/500 per
+            # request for services the workers own (docs/FLEET.md); the
+            # full-facade colocation rule would force this process to
+            # host every pipeline service and dual-consume the shards
+            need = set()
         missing = need - services
         if missing:
             raise SystemExit(
@@ -122,7 +131,7 @@ def _validate_split(services, remotes):
 
 
 def _build_runtime(settings, tenants, services=None, bus=None, remotes=None,
-                   wire_secret=None):
+                   wire_secret=None, fleet_controller=False):
     """Assemble a runtime. `services` (names) selects a subset for
     process-split deployment; `bus` may be a RemoteEventBus; `remotes`
     maps identifier -> (host, port) of peers hosting other services."""
@@ -134,7 +143,7 @@ def _build_runtime(settings, tenants, services=None, bus=None, remotes=None,
         if unknown:
             raise SystemExit(f"swx run: unknown services {sorted(unknown)} "
                              f"(known: {sorted(classes)})")
-    _validate_split(services, remotes)
+    _validate_split(services, remotes, fleet_controller=fleet_controller)
     rt = ServiceRuntime(settings, bus=bus)
     for name, cls in classes.items():
         if services is None or name in services:
@@ -245,8 +254,38 @@ async def cmd_run(args) -> int:
         remotes[identifier] = _parse_addr(addr)
 
     rt = _build_runtime(settings, tenants, services=services, bus=bus,
-                        remotes=remotes, wire_secret=wire_secret)
+                        remotes=remotes, wire_secret=wire_secret,
+                        fleet_controller=args.fleet_controller)
+    if args.fleet_controller:
+        # this process is the fleet's control plane (docs/FLEET.md):
+        # requires owning the broker bus (placement needs the central
+        # committed/head view, and the controller peeks the control
+        # topic for epoch recovery)
+        if args.bus:
+            raise SystemExit(
+                "swx run: --fleet-controller must run in the broker "
+                "process (in-proc bus); pair it with --kafka-port/"
+                "peers attaching via `swx fleet-worker`, not --bus")
+        from sitewhere_tpu.fleet import FleetController
+
+        rt.add_child(FleetController(rt))
     await rt.start()
+    bus_server = None
+    if args.serve_bus_port is not None:
+        from sitewhere_tpu.kernel.bus import EventBus
+        from sitewhere_tpu.kernel.wire import BusServer
+
+        if not isinstance(rt.bus, EventBus):
+            await rt.stop()
+            raise SystemExit("swx run: --serve-bus-port needs the "
+                             "in-proc bus (this process attaches to a "
+                             "remote broker via --bus)")
+        bus_server = BusServer(rt.bus, port=args.serve_bus_port,
+                               secret=wire_secret)
+        await bus_server.start()
+        print(f"swx bus served to wire peers on "
+              f"127.0.0.1:{bus_server.port}"
+              + (" (auth required)" if wire_secret else ""), flush=True)
     api_server = None
     if args.api_port is not None:
         from sitewhere_tpu.kernel.wire import ApiServer
@@ -310,6 +349,8 @@ async def cmd_run(args) -> int:
     if kafka_ep is not None:
         await kafka_ep.stop()
     if _dbg: print("SHUTDOWN: kafka endpoint stopped", flush=True)
+    if bus_server is not None:
+        await bus_server.stop()
     if api_server is not None:
         await api_server.stop()
     if _dbg: print("SHUTDOWN: api server stopped", flush=True)
@@ -509,6 +550,45 @@ def render_top(report: dict) -> str:
                     f"{sc.get('pending', 0):>8} "
                     f"{sc.get('inflight', 0):>8} "
                     f"{egress.get(tid, 0):>7}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines.append("")
+        lines.append(render_fleet(fleet))
+    return "\n".join(lines)
+
+
+def render_fleet(status: dict) -> str:
+    """Render a fleet status dict (`GET /api/fleet`) — the `swx fleet
+    status` / `swx top` placement view. Pure function for tests."""
+    lines = [
+        f"fleet epoch {status.get('epoch', 0)}  "
+        f"workers {len(status.get('workers') or {})}  "
+        f"tenants {len(status.get('tenants') or [])}  "
+        f"rebalances {status.get('rebalances', 0)}  "
+        f"converged {status.get('converged', False)}"]
+    workers = status.get("workers") or {}
+    if workers:
+        lines.append(f"  {'worker':<14} {'state':<9} {'owned':>5} "
+                     f"{'pending':>7} {'hb-age':>7}  tenants")
+        for wid, w in sorted(workers.items()):
+            state = ("retiring" if w.get("retiring")
+                     else "ready" if w.get("ready") else "syncing")
+            owned = w.get("owned") or []
+            lines.append(
+                f"  {wid:<14} {state:<9} {len(owned):>5} "
+                f"{len(w.get('pending') or []):>7} "
+                f"{w.get('last_heartbeat_age_s', 0):>6.1f}s  "
+                f"{','.join(owned[:6])}"
+                + ("…" if len(owned) > 6 else ""))
+    unplaced = status.get("unplaced") or []
+    if unplaced:
+        lines.append(f"  UNPLACED: {', '.join(unplaced)}")
+    decisions = (status.get("autoscaler") or {}).get("decisions") or []
+    if decisions:
+        last = decisions[-1]
+        lines.append(f"  autoscaler last: {last.get('action')} "
+                     f"({last.get('reason')})"
+                     + ("" if last.get("actuated") else " [advisory]"))
     return "\n".join(lines)
 
 
@@ -516,19 +596,10 @@ async def cmd_top(args) -> int:
     """Live operator view over `GET /api/instance/observe` — the
     flight recorder's critical path, loop-lag probe, consumer lag, and
     per-tenant flow/scoring state, refreshed every --interval."""
-    import base64
-
-    basic = base64.b64encode(
-        f"{args.user}:{args.password}".encode()).decode()
     try:
-        status, out = await _http_json(
-            "POST", args.host, args.port, "/api/jwt",
-            headers={"Authorization": f"Basic {basic}"})
-        if status != 200:
-            print(f"swx top: authentication failed ({status}): {out}",
-                  file=sys.stderr)
+        headers = await _rest_login(args, "swx top")
+        if headers is None:
             return 1
-        headers = {"Authorization": f"Bearer {out['token']}"}
         path = "/api/instance/observe"
         if args.tenant:
             path += f"?tenant={args.tenant}"
@@ -559,6 +630,67 @@ async def cmd_top(args) -> int:
         # Ctrl-C reaches the coroutine as CancelledError under
         # asyncio.run — the operator's normal exit, not a traceback
         return 0
+
+
+async def _rest_login(args, tool: str):
+    """Basic-auth → /api/jwt → bearer headers (the REST-client
+    subcommands' shared dance); None after printing the failure."""
+    import base64
+
+    basic = base64.b64encode(
+        f"{args.user}:{args.password}".encode()).decode()
+    status, out = await _http_json(
+        "POST", args.host, args.port, "/api/jwt",
+        headers={"Authorization": f"Basic {basic}"})
+    if status != 200:
+        print(f"{tool}: authentication failed ({status}): {out}",
+              file=sys.stderr)
+        return None
+    return {"Authorization": f"Bearer {out['token']}"}
+
+
+async def cmd_fleet(args) -> int:
+    """`swx fleet status` — placement/liveness/autoscaler view over
+    `GET /api/fleet` on the controller process's REST facade."""
+    try:
+        headers = await _rest_login(args, "swx fleet")
+        if headers is None:
+            return 1
+        status, report = await _http_json("GET", args.host, args.port,
+                                          "/api/fleet", headers=headers)
+        if status != 200:
+            print(f"swx fleet: status failed ({status}): {report}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            print(render_fleet(report))
+        return 0
+    except (OSError, asyncio.TimeoutError, IndexError, ValueError) as exc:
+        print(f"swx fleet: cannot reach REST at {args.host}:{args.port}: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return 1
+
+
+async def cmd_fleet_worker(args) -> int:
+    """`swx fleet-worker` — run one fleet worker attached to a broker
+    (`swx serve-bus`); tenant ownership arrives via placement records."""
+    from sitewhere_tpu.fleet.worker_main import amain
+
+    cfg = {
+        "worker_id": args.worker_id,
+        "host": _parse_addr(args.bus)[0],
+        "port": _parse_addr(args.bus)[1],
+        "instance_id": args.instance,
+        "secret": args.secret or os.environ.get("SWX_WIRE_SECRET"),
+        # the shared durable tier is how an adopting worker restores a
+        # tenant's device registry (docs/FLEET.md) — point every
+        # worker's --data-dir at the same path
+        "settings": ({"data_dir": args.data_dir} if args.data_dir
+                     else {}),
+    }
+    return await amain(cfg)
 
 
 async def cmd_simulate(args) -> int:
@@ -789,6 +921,17 @@ def main(argv=None) -> int:
     p_run.add_argument("--secret",
                        help="shared secret for wire bus/API connections "
                             "(default: SWX_WIRE_SECRET env)")
+    p_run.add_argument("--fleet-controller", action="store_true",
+                       help="host the fleet control plane in this "
+                            "process: placement/liveness/autoscaling "
+                            "for `swx fleet-worker` peers (tenants "
+                            "created here are registered for fleet "
+                            "placement; serve the bus to workers with "
+                            "--serve-bus-port)")
+    p_run.add_argument("--serve-bus-port", type=int, default=None,
+                       help="also serve this process's in-proc bus to "
+                            "wire peers on this port (the fleet "
+                            "workers' --bus target; 0 = ephemeral)")
 
     p_bus = sub.add_parser("serve-bus", parents=[common], help="run the wire bus broker")
     p_bus.add_argument("--host", default="127.0.0.1")
@@ -874,6 +1017,38 @@ def main(argv=None) -> int:
     p_top.add_argument("--user", default="admin")
     p_top.add_argument("--password", default="password")
 
+    p_fleet = sub.add_parser("fleet", parents=[common],
+                             help="fleet control-plane status "
+                                  "(placement, worker liveness, "
+                                  "autoscaler) via the REST API")
+    p_fleet.add_argument("action", choices=["status"])
+    p_fleet.add_argument("--host", default="127.0.0.1")
+    p_fleet.add_argument("--port", type=int, default=8080, help="REST port")
+    p_fleet.add_argument("--json", action="store_true",
+                         help="print the raw status JSON")
+    p_fleet.add_argument("--user", default="admin")
+    p_fleet.add_argument("--password", default="password")
+
+    p_fworker = sub.add_parser("fleet-worker", parents=[common],
+                               help="run one fleet worker against a wire "
+                                    "bus broker; tenant ownership arrives "
+                                    "via fleet placement records")
+    p_fworker.add_argument("--bus", required=True, metavar="HOST:PORT",
+                           help="the broker (`swx serve-bus`)")
+    p_fworker.add_argument("--worker-id", required=True,
+                           help="stable worker identity (placement key)")
+    p_fworker.add_argument("--instance", default="swx1",
+                           help="instance id (must match the broker's "
+                                "topic naming)")
+    p_fworker.add_argument("--secret",
+                           help="wire shared secret (default: "
+                                "SWX_WIRE_SECRET env)")
+    p_fworker.add_argument("--data-dir",
+                           help="shared durable tier (same path on "
+                                "every worker: adopting a tenant "
+                                "restores its registry snapshot from "
+                                "here — see docs/FLEET.md)")
+
     p_lint = sub.add_parser(
         "lint", parents=[common],
         help="run swxlint, the AST-based invariant checker "
@@ -935,7 +1110,7 @@ def main(argv=None) -> int:
 
         return subprocess.call([sys.executable, "bench.py", *extra,
                                 *(["--force-cpu"] if args.cpu else [])])
-    if args.cmd in ("run", "demo", "train"):
+    if args.cmd in ("run", "demo", "train", "fleet-worker"):
         # model-plane commands: resolve the backend first so a dead
         # tunnel degrades to CPU instead of hanging the command
         plat = _select_backend(args.cpu)
@@ -945,7 +1120,8 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", "cpu")
     coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
             "train": cmd_train, "serve-bus": cmd_serve_bus,
-            "dlq": cmd_dlq, "quota": cmd_quota, "top": cmd_top}[args.cmd]
+            "dlq": cmd_dlq, "quota": cmd_quota, "top": cmd_top,
+            "fleet": cmd_fleet, "fleet-worker": cmd_fleet_worker}[args.cmd]
     return asyncio.run(coro(args))
 
 

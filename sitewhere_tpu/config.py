@@ -110,6 +110,18 @@ class InstanceSettings:
     # splitting partitions. Tenant `egress: {fused, lanes}` overrides.
     egress_fused: bool = True
     egress_lanes: int = 1
+    # fleet control plane (sitewhere_tpu/fleet): `fleet_managed: true`
+    # marks a WORKER runtime whose tenant engines are driven by fleet
+    # placement records — the TenantEngineManager stands down (it must
+    # not spin engines off tenant-model-update broadcasts, or every
+    # worker would host every tenant and sharding would be fiction).
+    # Heartbeat cadence + the dead-after bound are the liveness contract
+    # between workers and the controller: a worker silent for
+    # `fleet_dead_after_s` is declared dead and its tenants reassign.
+    fleet_managed: bool = False
+    fleet_heartbeat_s: float = 1.0
+    fleet_dead_after_s: float = 5.0
+    fleet_interval_s: float = 0.5      # controller tick / poll cadence
     # log level
     log_level: str = "INFO"
 

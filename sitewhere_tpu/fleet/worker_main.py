@@ -1,0 +1,136 @@
+"""Fleet worker process entry: `python -m sitewhere_tpu.fleet.worker_main
+'<json-config>'` (or `swx fleet-worker`, cli.py).
+
+The config is one JSON object:
+
+    {"worker_id": "w0", "host": "127.0.0.1", "port": 47900,
+     "instance_id": "swx1",            # MUST match the broker's naming
+     "force_cpu": false,
+     "secret": null,                   # wire-auth shared secret
+     "settings": {...},                # InstanceSettings overrides
+     "chaos": {"seed": 0,              # optional fault injection
+               "sites": {"fleet.heartbeat": {"rate": 0.5,
+                                             "max_faults": 1}}}}
+
+Builds a `fleet_managed` ServiceRuntime over a `RemoteEventBus` with the
+scoring-pipeline services (the colocation set the split topology
+proved: device-mgmt, inbound, event-mgmt, device-state,
+rule-processing), attaches a `FleetWorker`, and runs until SIGTERM/
+SIGINT or until the controller retires the worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import sys
+
+
+def build_runtime(cfg: dict):
+    """Worker runtime from a config dict (bench + CLI entry share it)."""
+    from sitewhere_tpu.config import InstanceSettings
+    from sitewhere_tpu.fleet.worker import FleetWorker
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.kernel.wire import RemoteEventBus
+    from sitewhere_tpu.services import (
+        DeviceManagementService,
+        DeviceStateService,
+        EventManagementService,
+        InboundProcessingService,
+        RuleProcessingService,
+    )
+
+    settings = InstanceSettings(
+        instance_id=cfg["instance_id"], fleet_managed=True,
+        **(cfg.get("settings") or {}))
+    bus = RemoteEventBus(cfg.get("host", "127.0.0.1"), cfg["port"],
+                         secret=cfg.get("secret"))
+    rt = ServiceRuntime(settings, bus=bus)
+    for cls in (DeviceManagementService, InboundProcessingService,
+                EventManagementService, DeviceStateService,
+                RuleProcessingService):
+        rt.add_service(cls(rt))
+    worker = FleetWorker(rt, cfg["worker_id"])
+    rt.add_child(worker)
+    chaos = cfg.get("chaos")
+    if chaos:
+        from sitewhere_tpu.kernel.faults import FaultInjector
+
+        injector = FaultInjector(seed=int(chaos.get("seed", 0)))
+        sites = chaos.get("sites") or {}
+        # literal site names only (FLT01: the registry vouches for
+        # literals) — the worker-side chaos surface is the heartbeat
+        # loop; bus.poll rides the broker process, not this one
+        spec = sites.get("fleet.heartbeat")
+        if spec:
+            injector.arm("fleet.heartbeat",
+                         rate=float(spec.get("rate", 1.0)),
+                         max_faults=int(spec.get("max_faults", -1)))
+        rt.install_faults(injector)
+    return rt, worker
+
+
+async def amain(cfg: dict) -> int:
+    rt, worker = build_runtime(cfg)
+    await rt.start()
+    print(f"FLEET-WORKER {cfg['worker_id']} up", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover
+            pass
+    while not stop.is_set() and not worker.retired:
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=0.25)
+        except asyncio.TimeoutError:
+            pass
+    if worker.retired:
+        print(f"FLEET-WORKER {cfg['worker_id']} retired", flush=True)
+    await rt.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m sitewhere_tpu.fleet.worker_main "
+              "'<json-config>'", file=sys.stderr)
+        return 2
+    cfg = json.loads(argv[0])
+    if cfg.get("force_cpu"):
+        # must land before the first jax touch; the image re-asserts
+        # the accelerator platform at interpreter startup, so the
+        # config update is what actually sticks (see tests/conftest.py)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if cfg.get("jax_cache"):
+        # share the persistent compile cache with the driver/peers: a
+        # replacement worker adopting a tenant mid-run must not pay the
+        # full first-compile on shapes the fleet already compiled
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              cfg["jax_cache"])
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:  # noqa: BLE001 - cache is an optimization
+            pass
+    import logging
+
+    logging.basicConfig(
+        level=getattr(logging, str(cfg.get("log_level", "INFO")).upper(),
+                      logging.INFO),
+        format=f"%(asctime)s [{cfg.get('worker_id', '?')}] "
+               f"%(name)s %(levelname)s %(message)s")
+    return asyncio.run(amain(cfg))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -465,6 +465,7 @@ class TopicNaming:
     # instance-scoped
     TENANT_MODEL_UPDATES = "tenant-model-updates"
     INSTANCE_LOGS = "instance-logs"
+    FLEET_CONTROL = "fleet-control"              # placement/heartbeats (fleet/)
 
     def __init__(self, instance_id: str):
         self.instance_id = instance_id

@@ -38,6 +38,7 @@ bench artifacts as the `observe` block.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import time
 from collections import deque
@@ -81,6 +82,11 @@ class TelemetryBeat(BackgroundTaskComponent):
         # reporting its last backlog forever
         self._lag_groups: set[str] = set()
         self._egress_tenants: set[str] = set()
+        # None until the first sample resolves whether this runtime's
+        # bus answers group_lags locally (in-proc) or as an awaitable
+        # (wire: the broker owns that signal) — resolved ONCE, so a
+        # wire-bus worker doesn't build-and-discard a coroutine per beat
+        self._lags_local: Optional[bool] = None
 
     async def _run(self) -> None:
         import asyncio
@@ -131,8 +137,17 @@ class TelemetryBeat(BackgroundTaskComponent):
         # only; a wire-bus process reads lag on the broker process)
         lags: dict[str, int] = {}
         group_lags = getattr(runtime.bus, "group_lags", None)
-        if group_lags is not None:
-            for group, by_topic in group_lags().items():
+        if group_lags is not None and self._lags_local is not False:
+            lag_map = group_lags()
+            if inspect.isawaitable(lag_map):
+                # wire bus: the broker process owns the committed/head
+                # view — sample lag there (fleet controller does)
+                lag_map.close()
+                lag_map = {}
+                self._lags_local = False
+            else:
+                self._lags_local = True
+            for group, by_topic in lag_map.items():
                 total = sum(by_topic.values())
                 lags[group] = total
                 metrics.gauge(f"observe.consumer_lag:{group}").set(total)
@@ -204,11 +219,14 @@ class TelemetryBeat(BackgroundTaskComponent):
 
 def observe_report(runtime, tenant: Optional[str] = None) -> dict:
     """The flight recorder's one-call report: critical path over sampled
-    traces + the telemetry beat's live state. Served by
+    traces + the telemetry beat's live state (+ fleet placement when
+    this process hosts the controller). Served by
     `GET /api/instance/observe`, rendered by `swx top`, stamped into
     bench artifacts."""
     beat = getattr(runtime, "beat", None)
+    fleet = getattr(runtime, "fleet", None)
     return {
         "critical_path": runtime.tracer.critical_path(tenant=tenant),
         "beat": beat.snapshot() if beat is not None else None,
+        "fleet": fleet.snapshot() if fleet is not None else None,
     }

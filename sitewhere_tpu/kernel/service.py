@@ -178,6 +178,13 @@ class TenantEngineManager(BackgroundTaskComponent):
 
     async def _run(self) -> None:
         runtime = self.service.runtime
+        if getattr(runtime.settings, "fleet_managed", False):
+            # fleet worker runtime: engine ownership is decided by fleet
+            # placement records (sitewhere_tpu/fleet), applied through
+            # ServiceRuntime.adopt_tenant/release_tenant — reacting to
+            # tenant-model-update broadcasts here would make EVERY
+            # worker host EVERY tenant and un-shard the fleet
+            return
         consumer = runtime.bus.subscribe(
             runtime.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             group=f"{self.service.identifier}.tenant-engines",
@@ -249,7 +256,11 @@ class ServiceRuntime(LifecycleComponent):
             default_partitions=settings.bus_default_partitions,
             retention=settings.bus_retention)
         if isinstance(self.bus, LifecycleComponent):
-            self.add_child(self.bus)
+            if self.bus.parent is None:
+                self.add_child(self.bus)
+            # else: an in-proc bus another runtime already owns (the
+            # in-proc fleet topology: N runtimes share one bus) — use
+            # it, leave its lifecycle to the owning runtime
         else:
             self._external_bus = self.bus
         # per-tenant flow control (kernel/flow.py): quotas, weighted-fair
@@ -270,6 +281,11 @@ class ServiceRuntime(LifecycleComponent):
             self.add_child(self.beat)
         self.services: dict[str, Service] = {}
         self.remotes: dict[str, Any] = {}   # identifier -> RemoteService
+        # fleet control plane handle (sitewhere_tpu/fleet): the
+        # FleetController registers itself here on the runtime that
+        # hosts it, so REST (`GET /api/fleet`) and the observe report
+        # can surface placement without a service dependency
+        self.fleet = None
         self.tenants: dict[str, TenantConfig] = {}
         # chaos seam: a FaultInjector (kernel/faults.py) installed via
         # install_faults(); None in production — every consulted site
@@ -361,6 +377,10 @@ class ServiceRuntime(LifecycleComponent):
         self.tenants[tenant.tenant_id] = tenant
         self.flow.configure_tenant(tenant)
         self.tenant_epoch += 1
+        if self.fleet is not None:
+            # this process hosts the fleet control plane: tenant CRUD
+            # IS the placement roster (REST create/update included)
+            self.fleet.add_tenant(tenant)
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "created", "tenant": tenant}, key=tenant.tenant_id)
@@ -370,6 +390,8 @@ class ServiceRuntime(LifecycleComponent):
         self.tenants[tenant.tenant_id] = tenant
         self.flow.configure_tenant(tenant)
         self.tenant_epoch += 1
+        if self.fleet is not None:
+            self.fleet.add_tenant(tenant)
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "updated", "tenant": tenant}, key=tenant.tenant_id)
@@ -381,6 +403,8 @@ class ServiceRuntime(LifecycleComponent):
             return
         self.flow.drop_tenant(tenant_id)
         self.tenant_epoch += 1
+        if self.fleet is not None:
+            self.fleet.remove_tenant(tenant_id)
         await self.bus.produce(
             self.naming.instance_topic(TopicNaming.TENANT_MODEL_UPDATES),
             {"action": "deleted", "tenant": tenant}, key=tenant_id)
@@ -420,6 +444,39 @@ class ServiceRuntime(LifecycleComponent):
                     f"tenant {tenant_id} engines not {'ready' if present else 'removed'}"
                     f" in {timeout}s: {lagging}")
             await asyncio.sleep(0.005)
+
+    # -- fleet shard ownership (sitewhere_tpu/fleet) -------------------------
+
+    async def adopt_tenant(self, tenant: TenantConfig) -> None:
+        """Shard-scoped tenant spin-up: start this runtime's engines for
+        `tenant` WITHOUT the instance-wide broadcast. The fleet worker
+        calls this when placement assigns it a tenant; the engines join
+        the tenant's consumer groups on the shared bus and resume from
+        committed offsets (at-least-once across the handoff). Idempotent
+        for an equivalent config; a changed config respins the engines
+        (start_tenant_engine's equivalence guard)."""
+        self.tenants[tenant.tenant_id] = tenant
+        self.flow.configure_tenant(tenant)
+        self.tenant_epoch += 1
+        for service in self.services.values():
+            if service.multitenant \
+                    and service.status == LifecycleStatus.STARTED:
+                await service.start_tenant_engine(tenant)
+
+    async def release_tenant(self, tenant_id: str) -> None:
+        """Shard-scoped tenant drain: stop this runtime's engines for
+        the tenant (reverse service order — consumers drain, settle
+        barriers commit through, offsets persist in the shared group)
+        without broadcasting a delete. After this returns, no loop in
+        this process consumes the tenant's topics — the new owner may
+        safely resume from the committed offsets."""
+        if self.tenants.pop(tenant_id, None) is None:
+            return
+        self.flow.drop_tenant(tenant_id)
+        self.tenant_epoch += 1
+        for service in reversed(list(self.services.values())):
+            if service.multitenant:
+                await service.stop_tenant_engine(tenant_id)
 
     # -- external (wire) bus lifecycle --------------------------------------
 

@@ -294,6 +294,7 @@ class BusServer(WireServer):
             "close_consumer": self._op_close,
             "end_offsets": self._op_end_offsets,
             "topic_names": self._op_topic_names,
+            "group_lags": self._op_group_lags,
         }
 
     async def _op_produce(self, msg, writer=None) -> tuple[int, int]:
@@ -346,6 +347,12 @@ class BusServer(WireServer):
     async def _op_topic_names(self, msg, writer=None) -> list:
         return self.bus.topic_names()
 
+    async def _op_group_lags(self, msg, writer=None) -> dict:
+        # committed-vs-head lag per consumer group — the fleet
+        # controller's autoscaling input, served to any wire peer that
+        # wants the broker's central view (observe/fleet tooling)
+        return self.bus.group_lags()
+
     def on_disconnect(self, writer: asyncio.StreamWriter) -> None:
         for cid in self._by_conn.pop(writer, ()):
             consumer = self._consumers.pop(cid, None)
@@ -362,6 +369,17 @@ class RemoteBusConsumer:
         self.group = group
         self.name = name
         self._closed = False
+        # delivered-through positions, tracked CLIENT-side: a bare
+        # commit() must pin exactly what this process has been handed.
+        # Deferring to the server's current positions instead loses the
+        # race against the next poll REQUEST (commit is fire-and-forget,
+        # the poll is written immediately after it is spawned): the
+        # broker serves the new batch first, advances its positions,
+        # and the late commit then covers records this worker never
+        # processed — a SIGKILL in that window breaks at-least-once
+        # (measured: the fleet kill drill lost exactly one in-flight
+        # poll batch per killed consumer before this pin existed).
+        self._delivered: dict[tuple[str, int], int] = {}
 
     async def poll(self, *, max_records: int = 512,
                    timeout: float = 1.0) -> list[TopicRecord]:
@@ -381,13 +399,14 @@ class RemoteBusConsumer:
             ctx = getattr(value, "ctx", None)
             if ctx is not None and hasattr(ctx, "ingest_monotonic"):
                 ctx.ingest_monotonic = now
+            self._delivered[(t, p)] = off + 1
             out.append(TopicRecord(t, p, off, key, value, ts))
         return out
 
     def commit(self, positions: Optional[dict] = None) -> None:
-        rows = None
-        if positions is not None:
-            rows = [[t, p, off] for (t, p), off in positions.items()]
+        if positions is None:
+            positions = self._delivered
+        rows = [[t, p, off] for (t, p), off in positions.items()]
         self._client.spawn(
             self._client.call("commit", cid=self.cid, positions=rows))
 
@@ -401,6 +420,7 @@ class RemoteBusConsumer:
         return {(t, p): off for t, p, off in rows}
 
     def seek_to_beginning(self) -> None:
+        self._delivered.clear()  # positions reset with the seek
         self._client.spawn(self._client.call("seek_begin", cid=self.cid))
 
     def close(self) -> None:
@@ -448,6 +468,13 @@ class RemoteEventBus:
     def topic_names(self):
         """Awaitable; see `end_offsets`."""
         return self._client.call("topic_names")
+
+    def group_lags(self):
+        """Awaitable (the broker owns the committed/head view); callers
+        on possibly-remote paths guard with `inspect.isawaitable` — the
+        telemetry beat skips it and lets the broker-side process sample
+        lag centrally (kernel/observe.py)."""
+        return self._client.call("group_lags")
 
     async def produce(self, topic: str, value: Any, *,
                       key: Optional[str] = None,
@@ -542,6 +569,8 @@ class ApiServer(WireServer):
             "wait_engine": self._op_wait_engine,
             "call": self._op_call,
             "health": self._op_health,
+            "observe": self._op_observe,
+            "fleet": self._op_fleet,
         }
 
     async def _op_wait_engine(self, msg, writer=None) -> bool:
@@ -579,6 +608,19 @@ class ApiServer(WireServer):
 
     async def _op_health(self, msg, writer=None) -> dict:
         return self.runtime.health()
+
+    async def _op_observe(self, msg, writer=None) -> dict:
+        """The flight-recorder report for THIS process — fleet workers
+        expose their critical path / beat to peer tooling this way."""
+        from sitewhere_tpu.kernel.observe import observe_report
+
+        return observe_report(self.runtime, tenant=msg.get("tenant"))
+
+    async def _op_fleet(self, msg, writer=None) -> dict:
+        fleet = getattr(self.runtime, "fleet", None)
+        if fleet is None:
+            raise LookupError("no fleet controller in this process")
+        return fleet.snapshot()
 
 
 class RemoteEngineProxy:
@@ -626,6 +668,12 @@ class ApiChannel:
 
     async def health(self) -> dict:
         return await self._client.call("health")
+
+    async def observe(self, tenant: Optional[str] = None) -> dict:
+        return await self._client.call("observe", tenant=tenant)
+
+    async def fleet(self) -> dict:
+        return await self._client.call("fleet")
 
     def close(self) -> None:
         self._client.close()

@@ -10,6 +10,10 @@ rebuild's TPU-native distributed layer:
 - `tenant_stack.py`: per-tenant model multiplexing — stacked params with
   tenant-index dispatch, vmap'd scoring, tenant-axis sharding over the
   mesh (config 4 [BASELINE.json]).
+- `placement.py`: deterministic weighted-rendezvous tenant→worker
+  placement — the fleet control plane's (sitewhere_tpu/fleet) sharding
+  function, kept beside the mesh/stack layer because it is the same
+  question one level up: which compute owns which slice of the fleet.
 """
 
 from sitewhere_tpu.parallel.mesh import (
@@ -18,7 +22,13 @@ from sitewhere_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from sitewhere_tpu.parallel.placement import (
+    compute_placement,
+    placement_moves,
+    rendezvous_rank,
+)
 from sitewhere_tpu.parallel.tenant_stack import TenantStack
 
 __all__ = ["make_mesh", "batch_sharding", "replicated", "shard_batch",
-           "TenantStack"]
+           "TenantStack", "compute_placement", "placement_moves",
+           "rendezvous_rank"]

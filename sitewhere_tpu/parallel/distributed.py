@@ -54,6 +54,22 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["SWX_NUM_PROCESSES"])
     if process_id is None:
         process_id = int(os.environ["SWX_PROCESS_ID"])
+    # CPU backend: XLA ships no cross-process collectives by default —
+    # device_put/psum across the process group fail with "Multiprocess
+    # computations aren't implemented on the CPU backend" unless the
+    # gloo transport is selected BEFORE the backend initializes. TPU/GPU
+    # backends bring their own (ICI/DCN, NCCL) and must not be touched.
+    platforms = os.environ.get("JAX_PLATFORMS", "") \
+        or str(getattr(jax.config, "jax_platforms", None) or "")
+    if "cpu" in platforms or not platforms:
+        # explicit cpu, or nothing requested (a bare CPU-only host
+        # resolves to cpu too): selecting gloo only configures the CPU
+        # backend's collectives — accelerator backends are untouched
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older jaxlibs lack the option
+            logger.warning("could not select gloo CPU collectives; "
+                           "multi-process CPU runs may fail")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

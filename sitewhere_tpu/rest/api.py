@@ -335,6 +335,9 @@ class RestServer(LifecycleComponent):
         # pipeline flight recorder (kernel/observe.py): critical path +
         # telemetry beat, the `swx top` data source
         r("GET", r"/api/instance/observe", self.get_observe)
+        # fleet control plane (sitewhere_tpu/fleet): placement epoch,
+        # worker liveness, autoscaler decisions — `swx fleet status`
+        r("GET", r"/api/fleet", self.get_fleet)
         # pipeline tracing [SURVEY.md §5.1]; all three accept ?tenant=
         # and the listing endpoints paginate with ?limit=&offset=
         r("GET", r"/api/instance/traces", self.get_trace_summary)
@@ -516,6 +519,14 @@ class RestServer(LifecycleComponent):
         from sitewhere_tpu.kernel.observe import observe_report
 
         return observe_report(self.runtime, tenant=req.qp("tenant"))
+
+    async def get_fleet(self, req: Request):
+        """Fleet placement/liveness/autoscaler status — served by the
+        process hosting the FleetController (the broker-side runtime)."""
+        fleet = getattr(self.runtime, "fleet", None)
+        if fleet is None:
+            raise HttpError(404, "no fleet controller in this process")
+        return fleet.snapshot()
 
     async def get_trace_summary(self, req: Request):
         return self.runtime.tracer.stage_summary(tenant=req.qp("tenant"))

@@ -1,0 +1,541 @@
+"""Fleet control plane tests (sitewhere_tpu/fleet + parallel/placement).
+
+The ISSUE-10 acceptance surface: deterministic weighted placement, the
+drain-then-handoff invariant (old owner's engines stop and commit
+BEFORE the new owner starts — never dual-ownership, at-least-once
+across the move), automatic reassignment after a worker crash with
+zero lost accepted events, the `GET /api/fleet` / `swx fleet status` /
+`swx top` surfaces, autoscaler hysteresis/cooldown, and the
+fleet.heartbeat / fleet.rebalance chaos sites healing under the
+supervisor.
+
+Topology: in-proc — N worker ServiceRuntimes (fleet_managed) share ONE
+EventBus with a driver runtime hosting event-sources and the
+controller. Same protocol, same records, same consumer groups as the
+multi-process deployment (bench.py --workers); only the process
+boundary is collapsed. Workers share a data_dir so the adopting
+worker restores the tenant's device registry (the documented fleet
+deployment requirement, docs/FLEET.md).
+"""
+
+import asyncio
+import contextlib
+
+from sitewhere_tpu.cli import render_fleet, render_top
+from sitewhere_tpu.config import InstanceSettings, TenantConfig
+from sitewhere_tpu.domain.model import DeviceType
+from sitewhere_tpu.fleet import AutoscalerPolicy, FleetController, FleetWorker
+from sitewhere_tpu.kernel.observe import observe_report
+from sitewhere_tpu.kernel.service import ServiceRuntime
+from sitewhere_tpu.parallel.placement import (
+    compute_placement,
+    placement_moves,
+    rendezvous_rank,
+)
+from sitewhere_tpu.services import (
+    DeviceManagementService,
+    DeviceStateService,
+    EventManagementService,
+    EventSourcesService,
+    InboundProcessingService,
+    InstanceManagementService,
+    RuleProcessingService,
+)
+from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+from tests.test_pipeline import wait_until
+
+DEVICES = 64
+
+RP_SECTION = {"model": "zscore", "model_config": {"window": 8},
+              "threshold": 6.0, "batch_window_ms": 1.0,
+              "buckets": [DEVICES], "capacity": DEVICES}
+
+
+# ---------------------------------------------------------------------------
+# placement (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_placement_deterministic_and_stable():
+    tenants = {f"t{i}": 1.0 for i in range(40)}
+    workers = ["w0", "w1", "w2", "w3"]
+    a = compute_placement(tenants, workers)
+    b = compute_placement(tenants, list(reversed(workers)))
+    assert a == b, "placement must not depend on worker-list order"
+    assert set(a) == set(tenants)
+    counts = {w: sum(1 for t in a if a[t] == w) for w in workers}
+    assert all(c > 0 for c in counts.values()), counts
+    # rendezvous stability: removing one worker moves ONLY its tenants
+    shrunk = compute_placement(tenants, ["w0", "w1", "w2"])
+    moved = placement_moves(a, shrunk)
+    assert set(moved) == {t for t, w in a.items() if w == "w3"}, (
+        "removing w3 must only move w3's tenants")
+    # determinism of the preference order itself
+    assert rendezvous_rank("t0", workers) == rendezvous_rank("t0", workers)
+
+
+def test_placement_respects_weights():
+    # one heavy tenant (weight 8) + light ones: the capacity pass must
+    # not stack more weight onto the heavy tenant's worker than the
+    # headroom cap allows
+    tenants = {"heavy": 8.0, **{f"t{i}": 1.0 for i in range(8)}}
+    workers = ["w0", "w1"]
+    placed = compute_placement(tenants, workers, headroom=1.1)
+    load = {w: 0.0 for w in workers}
+    for tid, w in placed.items():
+        load[w] += tenants[tid]
+    cap = 1.1 * sum(tenants.values()) / 2
+    assert max(load.values()) <= cap + 8.0  # heavy itself may overshoot
+    heavy_worker = placed["heavy"]
+    lights_with_heavy = [t for t in placed
+                         if placed[t] == heavy_worker and t != "heavy"]
+    assert len(lights_with_heavy) <= 2, placed
+
+
+def test_placement_empty_inputs():
+    assert compute_placement({}, ["w0"]) == {}
+    assert compute_placement({"t": 1.0}, []) == {}
+
+
+# ---------------------------------------------------------------------------
+# in-proc fleet harness
+# ---------------------------------------------------------------------------
+
+
+def _worker_runtime(bus, wid, data_dir, **overrides):
+    rt = ServiceRuntime(InstanceSettings(
+        instance_id="fleet-test", fleet_managed=True,
+        fleet_heartbeat_s=0.2, observe_interval_ms=50.0,
+        data_dir=str(data_dir), **overrides), bus=bus)
+    for cls in (DeviceManagementService, InboundProcessingService,
+                EventManagementService, DeviceStateService,
+                RuleProcessingService):
+        rt.add_service(cls(rt))
+    worker = FleetWorker(rt, wid)
+    rt.add_child(worker)
+    return rt, worker
+
+
+async def _seed_registries(tmp_path, cfgs):
+    """Write each tenant's device-registry snapshot into the shared
+    data_dir BEFORE any worker adopts — whichever worker adopts
+    (initially, after a migration, after a crash) restores the same
+    fleet. This is the documented deployment shape (docs/FLEET.md:
+    tenant state rides the shared durable tier, not the worker)."""
+    seed = ServiceRuntime(InstanceSettings(
+        instance_id="fleet-test", data_dir=str(tmp_path)))
+    seed.add_service(DeviceManagementService(seed))
+    await seed.start()
+    for cfg in cfgs:
+        await seed.add_tenant(cfg)
+        dm = seed.api("device-management").management(cfg.tenant_id)
+        dm.bootstrap_fleet(DeviceType(token="thermo", name="T"), DEVICES)
+    await seed.stop()  # snapshotter save_now: registry.snap on disk
+
+
+@contextlib.asynccontextmanager
+async def fleet(tmp_path, n_workers=2, n_tenants=2, *, rest=False,
+                policy=None, spawner=None):
+    cfgs = [TenantConfig(tenant_id=f"t{i}",
+                         sections={"rule-processing": dict(RP_SECTION)})
+            for i in range(n_tenants)]
+    await _seed_registries(tmp_path, cfgs)
+    driver = ServiceRuntime(InstanceSettings(
+        instance_id="fleet-test", fleet_interval_s=0.05,
+        fleet_dead_after_s=1.5, rest_port=0))
+    driver.add_service(EventSourcesService(driver))
+    if rest:
+        driver.add_service(InstanceManagementService(driver))
+    controller = FleetController(
+        driver,
+        policy=policy or AutoscalerPolicy(min_workers=n_workers,
+                                          max_workers=n_workers),
+        spawner=spawner)
+    driver.add_child(controller)
+    await driver.start()
+    workers = {}
+    runtimes = {}
+    for i in range(n_workers):
+        wid = f"w{i}"
+        rt, worker = _worker_runtime(driver.bus, wid, tmp_path)
+        await rt.start()
+        runtimes[wid] = rt
+        workers[wid] = worker
+    for cfg in cfgs:
+        # local event-sources engines + (the driver hosts the
+        # controller) fleet placement registration, one call
+        await driver.add_tenant(cfg)
+    await wait_until(lambda: controller.snapshot()["converged"],
+                     timeout=120.0)
+    try:
+        yield driver, controller, runtimes, workers, cfgs
+    finally:
+        for rt in runtimes.values():
+            if rt.status.value != "stopped":
+                await rt.stop()
+        await driver.stop()
+
+
+class _Meter:
+    """Scored-events counters per tenant off the shared bus."""
+
+    def __init__(self, driver, cfgs):
+        self.consumers = {c.tenant_id: driver.bus.subscribe(
+            driver.naming.tenant_topic(c.tenant_id, "scored-events"),
+            group="fleet-test-meter") for c in cfgs}
+        self.scored = {c.tenant_id: 0 for c in cfgs}
+        self.sent = {c.tenant_id: 0 for c in cfgs}
+        self.sims = {c.tenant_id: DeviceSimulator(
+            SimConfig(num_devices=DEVICES), tenant_id=c.tenant_id)
+            for c in cfgs}
+        self.driver = driver
+        self._k = 0
+
+    async def submit_round(self):
+        for tid, sim in self.sims.items():
+            receiver = self.driver.api("event-sources") \
+                .engine(tid).receiver("default")
+            if await receiver.submit(sim.payload(t=1000.0 + self._k)[0]):
+                self.sent[tid] += DEVICES
+        self._k += 1
+
+    def drain(self):
+        for tid, consumer in self.consumers.items():
+            for record in consumer.poll_nowait(max_records=256):
+                self.scored[tid] += len(record.value)
+
+    async def drain_until_caught_up(self, timeout=90.0):
+        def caught_up():
+            self.drain()
+            return all(self.scored[t] >= self.sent[t] for t in self.sent)
+
+        await wait_until(caught_up, timeout=timeout)
+
+    def close(self):
+        for consumer in self.consumers.values():
+            consumer.close()
+
+
+async def _crash(runtimes, workers, wid):
+    """Kill a worker with crash fidelity: no leave, no releases — its
+    loops just stop and its engines vanish (in-proc stand-in for
+    SIGKILL; the consumers leave their groups exactly as the broker's
+    on_disconnect reaps a dead wire peer's)."""
+    worker = workers.pop(wid)
+    rt = runtimes.pop(wid)
+    for loop in (worker._control, worker._apply):
+        if loop._task is not None:
+            loop._task.cancel()
+    worker.owned.clear()          # _do_stop must not release/announce
+    rt.remove_child(worker)
+    await rt.stop()
+
+
+# ---------------------------------------------------------------------------
+# handoff invariant: migration
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_migration_drain_then_handoff(run, tmp_path):
+    async def main():
+        async with fleet(tmp_path, n_workers=2, n_tenants=2) as (
+                driver, controller, runtimes, workers, cfgs):
+            meter = _Meter(driver, cfgs)
+            for _ in range(4):
+                await meter.submit_round()
+            await meter.drain_until_caught_up()
+            before = dict(meter.scored)
+            assert all(v > 0 for v in before.values())
+
+            # migrate t0 to the worker that does NOT own it
+            source = controller.snapshot()["assignment"]["t0"]
+            target = next(w for w in workers if w != source)
+            controller.migrate("t0", target)
+            await wait_until(
+                lambda: controller.snapshot()["assignment"].get("t0")
+                == target and controller.snapshot()["converged"],
+                timeout=60.0)
+
+            # THE invariant: the old owner released (engines stopped,
+            # release published) strictly before the new owner adopted
+            assert workers[source].released_at["t0"] \
+                <= workers[target].adopted_at["t0"]
+            assert "t0" not in runtimes[source].tenants
+            assert "t0" in runtimes[target].tenants
+
+            # committed-offset resume: post-migration traffic scores
+            # (and nothing accepted before the move was lost)
+            for _ in range(3):
+                await meter.submit_round()
+            await meter.drain_until_caught_up()
+            assert meter.scored["t0"] >= meter.sent["t0"]
+
+            # handoff accounting
+            snap = driver.metrics.snapshot()
+            assert snap.get("fleet.rebalances", 0) >= 2
+            assert runtimes[target].metrics.counter(
+                "fleet.handoffs").value >= 1
+            meter.close()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# worker death: reassignment, zero loss, operator surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_worker_crash_reassigns_with_zero_loss(run, tmp_path):
+    async def main():
+        async with fleet(tmp_path, n_workers=2, n_tenants=2,
+                         rest=True) as (
+                driver, controller, runtimes, workers, cfgs):
+            meter = _Meter(driver, cfgs)
+            for _ in range(3):
+                await meter.submit_round()
+            await meter.drain_until_caught_up()
+
+            # kill the worker owning t0 MID-FLOOD: keep accepting events
+            # through the crash and the reassignment window
+            victim = controller.snapshot()["assignment"]["t0"]
+            survivor = next(w for w in workers if w != victim)
+            await meter.submit_round()
+            await _crash(runtimes, workers, victim)
+            for _ in range(4):
+                await meter.submit_round()
+                await asyncio.sleep(0.05)
+
+            # the controller declares the victim dead and reassigns;
+            # the survivor adopts WITHOUT waiting on a release (the
+            # dead cannot ack) and resumes from committed offsets
+            await wait_until(
+                lambda: victim not in controller.snapshot()["workers"],
+                timeout=30.0)
+            await wait_until(
+                lambda: controller.snapshot()["converged"], timeout=120.0)
+            snap = controller.snapshot()
+            assert all(w == survivor for w in snap["assignment"].values())
+            assert driver.metrics.counter("fleet.worker_deaths").value >= 1
+
+            # zero lost accepted events: everything the ingress accepted
+            # is scored (exactly-once-or-replayed — scored >= accepted)
+            for _ in range(2):
+                await meter.submit_round()
+            await meter.drain_until_caught_up(timeout=120.0)
+            for tid in meter.sent:
+                assert meter.scored[tid] >= meter.sent[tid], (
+                    tid, meter.sent[tid], meter.scored[tid])
+
+            # operator surfaces reflect the new placement:
+            # GET /api/fleet over real HTTP...
+            from tests.test_fleet import _http_get_fleet
+
+            report = await _http_get_fleet(driver)
+            assert set(report["workers"]) == {survivor}
+            assert all(w == survivor
+                       for w in report["assignment"].values())
+            # ...and the swx top / swx fleet renderings
+            text = render_fleet(report)
+            assert survivor in text and "fleet epoch" in text
+            top = render_top(observe_report(driver))
+            assert "fleet epoch" in top and survivor in top
+            meter.close()
+
+    run(main())
+
+
+async def _http_get_fleet(driver) -> dict:
+    """JWT dance + GET /api/fleet against the driver's live REST port."""
+    import base64
+    import json as _json
+
+    from sitewhere_tpu.cli import _http_json
+
+    port = driver.services["instance-management"].rest.port
+    basic = base64.b64encode(b"admin:password").decode()
+    status, out = await _http_json(
+        "POST", "127.0.0.1", port, "/api/jwt",
+        headers={"Authorization": f"Basic {basic}"})
+    assert status == 200, (status, out)
+    status, report = await _http_json(
+        "GET", "127.0.0.1", port, "/api/fleet",
+        headers={"Authorization": f"Bearer {out['token']}"})
+    assert status == 200, (status, report)
+    return _json.loads(_json.dumps(report))
+
+
+# ---------------------------------------------------------------------------
+# autoscaler decisions (hysteresis + cooldown)
+# ---------------------------------------------------------------------------
+
+
+def test_autoscaler_decisions_hysteresis_and_cooldown():
+    rt = ServiceRuntime(InstanceSettings(instance_id="fleet-unit"))
+    controller = FleetController(rt, policy=AutoscalerPolicy(
+        min_workers=1, max_workers=4, scale_up_lag=1000.0,
+        scale_down_lag=100.0, hysteresis=0.8, cooldown_s=10.0,
+        imbalance_ratio=3.0))
+    controller._last_scale_t = -1e9
+
+    # scale up: mean load per worker above the up threshold
+    decision = controller.decide({"w0": 3000.0, "w1": 100.0}, {})
+    assert decision and decision["action"] == "add_replica"
+
+    # cooldown: an immediately-following decision is suppressed
+    import time
+
+    controller._last_scale_t = time.monotonic()
+    assert controller.decide({"w0": 9000.0, "w1": 9000.0}, {}) is None
+    controller._last_scale_t = -1e9
+
+    # hysteresis band: below up, above down×hysteresis → hold
+    assert controller.decide({"w0": 150.0, "w1": 150.0}, {}) is None
+
+    # scale down: quiet fleet sheds its coolest worker
+    decision = controller.decide({"w0": 10.0, "w1": 50.0}, {})
+    assert decision and decision["action"] == "remove_replica"
+    assert decision["worker"] == "w0"
+
+    # replace-below-floor ignores cooldown (a dead worker must be
+    # replaced promptly)
+    controller._last_scale_t = time.monotonic()
+    decision = controller.decide({}, {})
+    assert decision and decision["action"] == "add_replica"
+
+    # migration: one hot worker owning several tenants, fleet balanced
+    # enough that a move beats a new replica
+    from sitewhere_tpu.fleet.controller import _WorkerState
+
+    controller._last_scale_t = -1e9
+    controller.tenants = {"a": None, "b": None, "c": None}
+    controller.workers = {
+        "w0": _WorkerState(last_seen=time.monotonic(),
+                           owned=("a", "b"), signals={}),
+        "w1": _WorkerState(last_seen=time.monotonic(),
+                           owned=("c",), signals={}),
+    }
+    decision = controller.decide({"w0": 700.0, "w1": 10.0},
+                                 {"a": 650.0, "b": 50.0, "c": 10.0})
+    assert decision and decision["action"] == "migrate_tenant", decision
+    assert decision["tenant"] == "a" and decision["worker"] == "w1"
+
+
+def test_worker_retirement_drains_and_exits(run, tmp_path):
+    """Scale-down end to end: a retired worker keeps heartbeating (so
+    peers can still wait on its releases), hands every tenant to the
+    survivors, and flags itself retired — the process entry exits on
+    that flag."""
+
+    async def main():
+        async with fleet(tmp_path, n_workers=2, n_tenants=2) as (
+                driver, controller, runtimes, workers, cfgs):
+            meter = _Meter(driver, cfgs)
+            await meter.submit_round()
+            await meter.drain_until_caught_up()
+
+            victim = controller.snapshot()["assignment"]["t0"]
+            survivor = next(w for w in workers if w != victim)
+            controller.retire_worker(victim)
+            await wait_until(lambda: workers[victim].retired,
+                             timeout=60.0)
+            snap = controller.snapshot()
+            assert all(w == survivor for w in snap["assignment"].values())
+            assert not runtimes[victim].tenants
+            # drain-then-handoff held through the retirement
+            for tid in snap["assignment"]:
+                if tid in workers[victim].released_at \
+                        and tid in workers[survivor].adopted_at:
+                    assert workers[victim].released_at[tid] \
+                        <= workers[survivor].adopted_at[tid]
+            # traffic still scores on the survivor
+            await meter.submit_round()
+            await meter.drain_until_caught_up()
+            meter.close()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# chaos: the fleet's own fault sites heal under the supervisor
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_chaos_sites_heal(run, tmp_path):
+    from sitewhere_tpu.kernel.faults import FaultInjector
+
+    async def main():
+        async with fleet(tmp_path, n_workers=1, n_tenants=1) as (
+                driver, controller, runtimes, workers, cfgs):
+            wid, rt = next(iter(runtimes.items()))
+
+            # fleet.heartbeat: the worker's control loop crashes once,
+            # restarts under the supervisor, and heartbeats resume —
+            # the worker is never declared dead
+            rt.install_faults(FaultInjector(seed=3).arm(
+                "fleet.heartbeat", rate=1.0, max_faults=1))
+            seq_before = controller.workers[wid].seq
+            await wait_until(
+                lambda: workers[wid]._control.restart_count >= 1,
+                timeout=30.0)
+            await wait_until(
+                lambda: controller.workers.get(wid) is not None
+                and controller.workers[wid].seq > seq_before + 1,
+                timeout=30.0)
+            assert wid in controller.snapshot()["workers"]
+
+            # fleet.rebalance: the controller loop crashes mid-publish,
+            # restarts, recovers its epoch off the control topic, and
+            # the pending rebalance still lands
+            driver.install_faults(FaultInjector(seed=4).arm(
+                "fleet.rebalance", rate=1.0, max_faults=1))
+            epoch_before = controller.epoch
+            extra = TenantConfig(tenant_id="late",
+                                 sections={"rule-processing":
+                                           dict(RP_SECTION)})
+            await driver.add_tenant(extra)  # CRUD feeds placement
+            await wait_until(
+                lambda: controller._loop.restart_count >= 1, timeout=30.0)
+            await wait_until(
+                lambda: controller.snapshot()["assignment"].get("late")
+                == wid, timeout=60.0)
+            assert controller.epoch > epoch_before
+            # the injected crashes were quarantine-free (no poison
+            # record involved) and bounded — the fleet is converged
+            await wait_until(
+                lambda: controller.snapshot()["converged"], timeout=60.0)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# wire surface: the broker serves group lags to remote peers
+# ---------------------------------------------------------------------------
+
+
+def test_wire_group_lags_op(run):
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.wire import BusServer, RemoteEventBus
+
+    async def main():
+        bus = EventBus()
+        await bus.produce("fleet-test.tenant.acme.inbound-events", {"n": 1},
+                          key="d1")
+        consumer = bus.subscribe("fleet-test.tenant.acme.inbound-events",
+                                 group="acme.inbound-processing")
+        server = BusServer(bus)
+        await server.start()
+        remote = RemoteEventBus("127.0.0.1", server.port)
+        await remote.initialize()
+        import inspect
+
+        lags = remote.group_lags()
+        assert inspect.isawaitable(lags)
+        lag_map = await lags
+        assert lag_map["acme.inbound-processing"][
+            "fleet-test.tenant.acme.inbound-events"] == 1
+        consumer.close()
+        await remote.stop()
+        await server.stop()
+
+    run(main())

@@ -119,6 +119,50 @@ def test_wire_bus_produce_poll_commit_rebalance(run):
     run(main())
 
 
+def test_wire_commit_pins_delivered_positions(run):
+    """At-least-once across a worker SIGKILL hinges on this: a bare
+    commit() must cover exactly the records DELIVERED to this process,
+    never the broker-side consumer's current positions. The
+    fire-and-forget commit RPC loses the wire race against the next
+    poll request (which is written immediately, while the commit task
+    waits a scheduler tick), so a server-positions commit would cover
+    the new in-flight batch — and a process killed while handling it
+    would never see those records again (the fleet kill drill measured
+    exactly one poll batch lost per killed consumer this way)."""
+
+    async def main():
+        bus = EventBus(default_partitions=1)
+        server = BusServer(bus)
+        await server.start()
+        remote = RemoteEventBus("127.0.0.1", server.port)
+        await remote.initialize()
+        await remote.produce("t", {"i": 0})
+        await remote.produce("t", {"i": 1})
+        consumer = remote.subscribe("t", group="g")
+        first = await consumer.poll(max_records=1, timeout=2.0)
+        assert [r.value["i"] for r in first] == [0]
+        # the consuming loop's shape: commit what was processed, then
+        # immediately poll the next batch — the poll request reaches
+        # the broker before the spawned commit RPC does
+        consumer.commit()
+        second = await consumer.poll(max_records=1, timeout=2.0)
+        assert [r.value["i"] for r in second] == [1]
+        await asyncio.sleep(0.1)  # let the commit RPC land (after poll)
+        # "kill" the worker mid-batch: record {i: 1} was delivered but
+        # never committed — a successor in the group MUST see it again
+        consumer.close()
+        await asyncio.sleep(0.05)
+        successor = remote.subscribe("t", group="g")
+        redelivered = await successor.poll(max_records=10, timeout=2.0)
+        assert [r.value["i"] for r in redelivered] == [1], (
+            "commit covered an undelivered in-flight batch")
+        successor.close()
+        await remote.stop()
+        await server.stop()
+
+    run(main())
+
+
 def test_api_channel_engine_calls(run):
     """Control plane: a peer resolves an engine and calls its methods
     (numpy in/out) over the wire, with wait-for-engine semantics."""
