@@ -629,24 +629,11 @@ async def run_fleet_bench(args) -> dict:
     data_dir = tempfile.mkdtemp(prefix="swx-fleet-bench-")
     tenant_ids = [f"bench{i}" for i in range(n_tenants)]
 
-    # tenant state tier: write each tenant's device-registry snapshot
-    # into the SHARED data_dir before any worker adopts — an adopting
-    # (or replacement) worker restores the fleet from it, which is the
-    # documented deployment requirement (docs/FLEET.md)
-    reg_rt = ServiceRuntime(InstanceSettings(
-        instance_id="fleet-bench", data_dir=data_dir))
-    reg_rt.add_service(DeviceManagementService(reg_rt))
-    await reg_rt.start()
-    for tid in tenant_ids:
-        await reg_rt.add_tenant(TenantConfig(tenant_id=tid))
-        dm = reg_rt.api("device-management").management(tid)
-        dm.bootstrap_fleet(DeviceType(token="thermo", name="T"),
-                           per_tenant)
-    await reg_rt.stop()  # snapshotter save_now: registry.snap on disk
-
     # bus tier: deep retention so a reassignment window can never trim
     # records the kill drill still owes the new owner (zero-loss is the
-    # acceptance number; a retention overrun would fake a loss)
+    # acceptance number; a retention overrun would fake a loss). The
+    # driver runtime owns it, so broker-side `fence.rejections` count
+    # on the driver's registry.
     bus = EventBus(default_partitions=4, retention=65536)
     rt = ServiceRuntime(InstanceSettings(
         instance_id="fleet-bench", bus_retention=65536,
@@ -654,6 +641,23 @@ async def run_fleet_bench(args) -> dict:
         fleet_interval_s=0.25, fleet_dead_after_s=6.0,
         flow_degrade_at=10.0, flow_defer_at=10.0), bus=bus)
     rt.add_service(EventSourcesService(rt))
+
+    # tenant state tier — HERMETIC (docs/FLEET.md fencing protocol):
+    # the seeding runtime shares the broker bus with replication on, so
+    # every bootstrap registration lands on the per-tenant
+    # registry-state topic; workers adopt from BUS REPLAY alone (no
+    # shared data_dir — the pre-fencing deployment requirement this
+    # drill topology removed)
+    reg_rt = ServiceRuntime(InstanceSettings(
+        instance_id="fleet-bench", registry_replication=True), bus=bus)
+    reg_rt.add_service(DeviceManagementService(reg_rt))
+    await reg_rt.start()
+    for tid in tenant_ids:
+        await reg_rt.add_tenant(TenantConfig(tenant_id=tid))
+        dm = reg_rt.api("device-management").management(tid)
+        dm.bootstrap_fleet(DeviceType(token="thermo", name="T"),
+                           per_tenant)
+    await reg_rt.stop()  # replicator seal: snapshot records on the bus
 
     procs: dict[str, subprocess.Popen] = {}
     wids = iter(range(10_000))
@@ -669,7 +673,10 @@ async def run_fleet_bench(args) -> dict:
                 "engine_ready_timeout_s": args.ready_timeout,
                 "fleet_heartbeat_s": 0.25,
                 "flow_degrade_at": 10.0, "flow_defer_at": 10.0,
-                "data_dir": data_dir,
+                # worker-LOCAL scratch (registry WAL + snapshots), one
+                # private dir per worker — NOT a shared mount: adoption
+                # state comes from bus replay (hermetic fleet)
+                "data_dir": os.path.join(data_dir, wid),
             },
         }
         if args.chaos:
@@ -773,13 +780,41 @@ async def run_fleet_bench(args) -> dict:
         # fast one process can fill a log (and drains stay bounded)
         outstanding_cap = per_tenant * 32
 
-        async def flood(seconds: float, *, kill_at: float = -1.0):
-            """Offered load on every tenant; returns (accepted, kill)."""
+        def _busiest_live_worker():
+            snap = controller.snapshot()
+            candidates = sorted(
+                ((len(w["owned"]), wid)
+                 for wid, w in snap["workers"].items()
+                 if wid in procs and procs[wid].poll() is None),
+                reverse=True)
+            if not candidates:
+                return None, ()
+            victim = candidates[0][1]
+            return victim, snap["workers"][victim]["owned"]
+
+        async def flood(seconds: float, *, kill_at: float = -1.0,
+                        stop_at: float = -1.0):
+            """Offered load on every tenant; returns (accepted, info).
+
+            `kill_at` runs the SIGKILL drill (worker death). `stop_at`
+            runs the ZOMBIE drill: SIGSTOP the busiest worker (a
+            false-positive death — the process is alive, just stalled
+            past `dead_after`), then SIGCONT it the moment the
+            controller declares it dead and reassigns — i.e. MID
+            reassignment, while the adopter is still spinning engines.
+            The resumed zombie's data-path writes must then be FENCED
+            (rejected broker-side), not tolerated; the flood keeps
+            running until the SIGCONT lands so the zombie resumes under
+            live traffic."""
+            import signal as _signal
+
             sent = {tid: 0 for tid in tenant_ids}
-            kill_info = None
+            info = None
             t0 = time.monotonic()
             k = 0
-            while time.monotonic() - t0 < seconds:
+            while (time.monotonic() - t0 < seconds
+                   or (stop_at >= 0 and info is not None
+                       and info.get("t_cont") is None)):
                 progressed = False
                 for tid in tenant_ids:
                     if sent_total[tid] + sent[tid] - scored[tid] \
@@ -794,25 +829,42 @@ async def run_fleet_bench(args) -> dict:
                 drain_scored()
                 if not progressed:
                     await asyncio.sleep(0.002)
-                if kill_at >= 0 and kill_info is None \
+                if kill_at >= 0 and info is None \
                         and time.monotonic() - t0 >= kill_at:
-                    snap = controller.snapshot()
-                    candidates = sorted(
-                        ((len(w["owned"]), wid)
-                         for wid, w in snap["workers"].items()
-                         if wid in procs and procs[wid].poll() is None),
-                        reverse=True)
-                    if candidates:
-                        victim = candidates[0][1]
-                        owned = snap["workers"][victim]["owned"]
+                    victim, owned = _busiest_live_worker()
+                    if victim is not None:
                         procs[victim].kill()
-                        kill_info = {"worker": victim, "owned": owned,
-                                     "t_kill": time.monotonic()}
+                        info = {"worker": victim, "owned": owned,
+                                "t_kill": time.monotonic()}
                         print(f"[fleet bench] SIGKILL {victim} "
                               f"(owned {owned})", file=sys.stderr)
+                if stop_at >= 0 and info is None \
+                        and time.monotonic() - t0 >= stop_at:
+                    victim, owned = _busiest_live_worker()
+                    if victim is not None:
+                        procs[victim].send_signal(_signal.SIGSTOP)
+                        info = {"worker": victim, "owned": owned,
+                                "t_stop": time.monotonic()}
+                        print(f"[fleet bench] SIGSTOP {victim} "
+                              f"(owned {owned}) — false-positive death "
+                              f"incoming", file=sys.stderr)
+                if stop_at >= 0 and info is not None \
+                        and info.get("t_cont") is None:
+                    snap = controller.snapshot()
+                    if info["worker"] not in snap["workers"]:
+                        # declared dead; tenants reassigned in a new
+                        # epoch — resume the zombie NOW, mid-handoff
+                        procs[info["worker"]].send_signal(_signal.SIGCONT)
+                        info["t_cont"] = time.monotonic()
+                        info["declared_dead_s"] = round(
+                            info["t_cont"] - info["t_stop"], 2)
+                        print(f"[fleet bench] SIGCONT {info['worker']} "
+                              f"mid-reassignment (declared dead after "
+                              f"{info['declared_dead_s']}s)",
+                              file=sys.stderr)
             for tid in tenant_ids:
                 sent_total[tid] += sent[tid]
-            return sent, kill_info
+            return sent, info
 
         async def drain_until(bound: float) -> bool:
             deadline = time.monotonic() + bound
@@ -943,6 +995,79 @@ async def run_fleet_bench(args) -> dict:
                 "drain_complete": drain_ok,
             }
 
+        # ---- phase 3: zombie drill (false-positive death + fencing) ----
+        # SIGSTOP the busiest worker past dead_after (the controller
+        # believes it died; its tenants reassign), SIGCONT it MID
+        # reassignment, mid-flood. Acceptance: zero lost accepted
+        # events, the zombie's resumed data-path writes REJECTED
+        # broker-side (fenced_rejections >= 1, the dual-ownership
+        # window closed by construction), and a post-reconvergence
+        # flood scoring EXACTLY once (0 duplicate committed events —
+        # the steady state after fencing is clean, with the bounded
+        # at-least-once redelivery of the handoff counted separately
+        # as replayed_events).
+        zombie_stats = None
+        if n_workers >= 2 and args.zombie_drill:
+            base = dict(scored)
+            deaths0 = rt.metrics.counter("fleet.worker_deaths").value
+            rejections0 = (bus.fences.rejections
+                           if bus.fences is not None else 0)
+            sent, zombie_info = await flood(
+                args.seconds, stop_at=args.seconds * 0.3)
+            reconverged_s = None
+            if zombie_info is not None:
+                t_wait = time.monotonic()
+                while time.monotonic() - t_wait < 180.0:
+                    snap = controller.snapshot()
+                    if snap["converged"]:
+                        reconverged_s = round(
+                            time.monotonic() - zombie_info["t_stop"], 2)
+                        break
+                    drain_scored()
+                    await asyncio.sleep(0.25)
+            drain_ok = await drain_until(args.drain_timeout + 120.0)
+            lost = sum(max(sent_total[t] - scored[t], 0)
+                       for t in tenant_ids)
+            dup = sum(max(scored[t] - sent_total[t], 0)
+                      for t in tenant_ids)
+            group_lags = bus.group_lags()
+            decoded_backlog = sum(
+                sum(group_lags.get(f"{tid}.inbound-processing",
+                                   {}).values())
+                for tid in tenant_ids)
+            fenced = (bus.fences.rejections
+                      if bus.fences is not None else 0) - rejections0
+            # post-reconvergence exactness: with the zombie fenced out
+            # and the fleet converged, a fresh flood must land exactly
+            # once — any surplus here would be a REAL duplicate commit
+            post_base = dict(scored)
+            post_sent, _ = await flood(min(args.seconds, 5.0))
+            post_ok = await drain_until(args.drain_timeout)
+            post_dup = sum((scored[t] - post_base[t]) for t in tenant_ids) \
+                - sum(post_sent.values())
+            zombie_stats = {
+                "zombie_worker": (zombie_info or {}).get("worker"),
+                "zombie_owned": (zombie_info or {}).get("owned"),
+                "false_positive_death_detected": bool(rt.metrics.counter(
+                    "fleet.worker_deaths").value > deaths0),
+                "declared_dead_s": (zombie_info or {}).get(
+                    "declared_dead_s"),
+                "sigcont_mid_reassignment": bool(
+                    (zombie_info or {}).get("t_cont")),
+                "reconverged_after_stop_s": reconverged_s,
+                "fenced_rejections": int(max(fenced, 0)),
+                "accepted_events": int(sum(sent.values())),
+                "scored_events": int(
+                    sum(scored[t] - base[t] for t in tenant_ids)),
+                "lost_accepted_events": int(lost),
+                "replayed_events": int(dup),
+                "decoded_backlog_after_drain": int(decoded_backlog),
+                "drain_complete": drain_ok,
+                "post_reconverge_accepted": int(sum(post_sent.values())),
+                "duplicate_committed_events": int(max(post_dup, 0)),
+                "post_reconverge_drain_complete": post_ok,
+            }
+
         final = controller.snapshot()
         for consumer in meters.values():
             consumer.close()
@@ -969,6 +1094,10 @@ async def run_fleet_bench(args) -> dict:
                 "epoch": final["epoch"],
                 "converge_s": round(converge_s, 2),
                 "kill": kill_stats,
+                "zombie": zombie_stats,
+                "fence_rejections_total": (bus.fences.rejections
+                                           if bus.fences is not None
+                                           else 0),
                 "autoscaler_decisions": controller.decisions[-8:],
             },
             "saturation_trials": trials,
@@ -1898,6 +2027,14 @@ def main() -> None:
     parser.add_argument("--no-fleet-kill", action="store_true",
                         help="skip the scripted mid-flood worker SIGKILL "
                              "drill in --workers mode")
+    parser.add_argument("--zombie-drill", action="store_true",
+                        help="--workers mode: SIGSTOP the busiest worker "
+                             "past dead_after (false-positive death), "
+                             "SIGCONT it mid-reassignment, and prove the "
+                             "zombie's resumed writes are FENCED (epoch "
+                             "fencing, docs/FLEET.md) — artifact gains "
+                             "fleet.zombie (fenced_rejections, lost/"
+                             "duplicate counts)")
     parser.add_argument("--gnn", action="store_true",
                         help="config-5 bench: fleet graph build + GNN "
                              "risk scoring at fleet sizes 1k/10k")

@@ -159,6 +159,7 @@ Checker = Callable[[Module, Project], Iterable[Finding]]
 
 def default_checkers() -> list[Checker]:
     from sitewhere_tpu.analysis.checkers_async import check_async_blocking
+    from sitewhere_tpu.analysis.checkers_fence import check_fence_token
     from sitewhere_tpu.analysis.checkers_flow import (
         check_dlq_quarantine,
         check_flow_consult,
@@ -175,7 +176,7 @@ def default_checkers() -> list[Checker]:
 
     return [check_async_blocking, check_flow_consult, check_dlq_quarantine,
             check_fault_sites, check_metric_names, check_lifecycle_super,
-            check_trace_parity, check_trace_stages]
+            check_trace_parity, check_trace_stages, check_fence_token]
 
 
 # -- baseline ----------------------------------------------------------------

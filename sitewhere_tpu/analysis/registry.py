@@ -41,6 +41,7 @@ FAULT_SITES = frozenset({
     "observe.beat",       # kernel/observe.py telemetry-beat sampler tick
     "fleet.heartbeat",    # fleet/worker.py heartbeat publish
     "fleet.rebalance",    # fleet/controller.py placement publish
+    "fence.adopt",        # services/device_management.py replay-on-adopt
 })
 
 # -- trace stages (kernel/tracing.py spans; TRC01 resolves literals) ---------
@@ -131,6 +132,10 @@ COUNTERS = (
     "fleet.worker_deaths",
     "fleet.autoscale_up",
     "fleet.autoscale_down",
+    # epoch fencing + replicated tenant state (docs/FLEET.md)
+    "fence.rejections",   # stale-epoch data-path writes rejected
+    "fence.replays",      # journal records replayed on adoption
+    "fence.wal_appends",  # registry WAL appends (crash-bound tightener)
 )
 
 GAUGES = (

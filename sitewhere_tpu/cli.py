@@ -253,6 +253,13 @@ async def cmd_run(args) -> int:
                 f"swx: --remote wants SVC=HOST:PORT, got {spec!r}")
         remotes[identifier] = _parse_addr(addr)
 
+    if args.fleet_controller and settings.registry_replication is None:
+        # controller host = the tenant-seeding host: its registry
+        # mutations must reach the per-tenant registry-state topic so
+        # workers adopt hermetically (docs/FLEET.md fencing protocol)
+        import dataclasses as _dc
+
+        settings = _dc.replace(settings, registry_replication=True)
     rt = _build_runtime(settings, tenants, services=services, bus=bus,
                         remotes=remotes, wire_secret=wire_secret,
                         fleet_controller=args.fleet_controller)
@@ -684,9 +691,10 @@ async def cmd_fleet_worker(args) -> int:
         "port": _parse_addr(args.bus)[1],
         "instance_id": args.instance,
         "secret": args.secret or os.environ.get("SWX_WIRE_SECRET"),
-        # the shared durable tier is how an adopting worker restores a
-        # tenant's device registry (docs/FLEET.md) — point every
-        # worker's --data-dir at the same path
+        # worker-LOCAL durability only: registry state replicates over
+        # the bus (docs/FLEET.md fencing protocol), so adoption needs no
+        # shared filesystem — --data-dir just tightens the single-node
+        # crash bound (registry WAL) and spills event history
         "settings": ({"data_dir": args.data_dir} if args.data_dir
                      else {}),
     }
@@ -1044,10 +1052,12 @@ def main(argv=None) -> int:
                            help="wire shared secret (default: "
                                 "SWX_WIRE_SECRET env)")
     p_fworker.add_argument("--data-dir",
-                           help="shared durable tier (same path on "
-                                "every worker: adopting a tenant "
-                                "restores its registry snapshot from "
-                                "here — see docs/FLEET.md)")
+                           help="OPTIONAL worker-local durability root "
+                                "(registry WAL + snapshots, event "
+                                "spill). NOT shared: tenant registry "
+                                "state replicates over the bus, so a "
+                                "worker adopts from bus replay alone — "
+                                "see docs/FLEET.md fencing protocol")
 
     p_lint = sub.add_parser(
         "lint", parents=[common],

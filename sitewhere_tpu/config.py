@@ -122,6 +122,15 @@ class InstanceSettings:
     fleet_heartbeat_s: float = 1.0
     fleet_dead_after_s: float = 5.0
     fleet_interval_s: float = 0.5      # controller tick / poll cadence
+    # replicated tenant state (services/replication.py): publish the
+    # device-registry mutation stream + interleaved snapshots on the
+    # per-tenant registry-state topic, so an adopting worker rebuilds
+    # the registry from BUS REPLAY — no shared data_dir required
+    # (docs/FLEET.md). None = on for fleet_managed workers, off
+    # elsewhere; tenant `device-management: {replicate}` overrides.
+    # Set True on the process that SEEDS tenants (ingress/controller
+    # host) so bootstrap registrations reach the state topic too.
+    registry_replication: Optional[bool] = None
     # log level
     log_level: str = "INFO"
 

@@ -491,6 +491,7 @@ class FleetController(LifecycleComponent):
         self.runtime.metrics.gauge("fleet.tenants_pending").set(
             len(self.tenants) - len(
                 [t for t in self.assignment if self.owners.get(t)]))
+        fences = getattr(self.runtime.bus, "fences", None)
         return {
             "epoch": self.epoch,
             "workers": workers,
@@ -505,6 +506,17 @@ class FleetController(LifecycleComponent):
                 "policy": asdict(self.policy),
                 "decisions": self.decisions[-8:],
             },
+            # epoch fencing (docs/FLEET.md): the broker-side authority's
+            # allowed-writer view + rejected-zombie-write count — absent
+            # until the first placement record builds the authority
+            "fencing": (None if fences is None else {
+                "rejections": fences.rejections,
+                "owners": {t: {"worker": w, "epoch": e}
+                           for t, (w, e) in sorted(fences.owners.items())},
+                "pending": {t: {"worker": w, "epoch": e}
+                            for t, (w, e)
+                            in sorted(fences.pending.items())},
+            }),
         }
 
 

@@ -79,12 +79,26 @@ class FleetWorker(LifecycleComponent):
         self.adopted_at: dict[str, float] = {}    # diagnostics/tests
         self.released_at: dict[str, float] = {}
         self._move_started: dict[str, float] = {}  # pending → handoff_s
+        # epoch fencing (docs/FLEET.md): tenants whose data-path writes
+        # the broker REJECTED (we are a zombie owner — false-positive
+        # death, stalled loop) mapped to the epoch we held when fenced;
+        # the apply loop stops their engines and refuses to re-adopt
+        # until a strictly newer placement assigns them here again
+        self._fenced_at: dict[str, int] = {}
+        runtime.fence.worker_id = worker_id
+        runtime.fence.on_lost = self._on_fence_lost
         self._dirty = asyncio.Event()
         self._seq = 0
         self._control = _WorkerControlLoop(self)
         self._apply = _WorkerApplyLoop(self)
         self.add_child(self._control)
         self.add_child(self._apply)
+
+    def _on_fence_lost(self, tenant_id: str) -> None:
+        """FenceState callback (sync, any loop): a broker rejected our
+        write for this tenant — schedule the engine stop."""
+        self._fenced_at[tenant_id] = self.epoch
+        self._dirty.set()
 
     # -- views ---------------------------------------------------------------
 
@@ -122,6 +136,11 @@ class FleetWorker(LifecycleComponent):
             # retains every release record it ever saw
             self.releases = {(t, e) for t, e in self.releases
                              if e >= epoch}
+            # a fence recorded at an OLDER epoch is cleared by a newer
+            # placement: if that placement assigns the tenant here, the
+            # adoption is a legitimate fresh grant, not a zombie retry
+            self._fenced_at = {t: e for t, e in self._fenced_at.items()
+                               if e >= epoch}
             now = time.monotonic()
             for tid in self.pending():
                 self._move_started.setdefault(tid, now)
@@ -186,13 +205,28 @@ class FleetWorker(LifecycleComponent):
         rt = self.runtime
         mine = self.assigned_to_me()
         metrics = rt.metrics
-        # release first: the loser drains and commits BEFORE any adopter
+        # fenced first: the broker REJECTED our data-path writes for
+        # these tenants — we are a zombie owner (false-positive death).
+        # Stop the engines now and publish NO release: the fence already
+        # transferred ownership, and a release under our stale epoch
+        # would only confuse adopters. Offsets were never advanced by
+        # us past the fence, so the real owner resumes exactly where
+        # the broker last accepted a commit.
+        for tid in sorted(set(self._fenced_at) & self.owned):
+            logger.warning("%s: tenant %s FENCED (ownership moved while "
+                           "we were stalled) — stopping engines, not "
+                           "retrying", self.name, tid)
+            await rt.release_tenant(tid)
+            self.owned.discard(tid)
+            rt.fence.revoke(tid)
+        # release next: the loser drains and commits BEFORE any adopter
         # may start — the ordering that makes dual-ownership impossible
         for tid in sorted(self.owned - mine):
             if self.assignment.get(tid) == self.worker_id:
                 continue  # a newer epoch gave it back mid-pass
             await rt.release_tenant(tid)
             self.owned.discard(tid)
+            rt.fence.revoke(tid)
             self.released_at[tid] = time.monotonic()
             metrics.counter("fleet.releases").inc()
             await rt.bus.produce(self.control_topic, {
@@ -207,6 +241,11 @@ class FleetWorker(LifecycleComponent):
                 # pass was compiling and moved this tenant elsewhere —
                 # acting on the stale view would dual-own it with the
                 # new assignee (who sees it owner-free and adopts)
+                continue
+            if self._fenced_at.get(tid, -1) >= self.epoch:
+                # fenced at this (or a newer) epoch: our placement view
+                # is the stale one — only a strictly newer epoch that
+                # assigns the tenant here again may re-adopt it
                 continue
             if not self._adoptable(tid):
                 continue  # wait for the previous owner's release
@@ -223,6 +262,9 @@ class FleetWorker(LifecycleComponent):
             await self.heartbeat()
             if self.assignment.get(tid) != self.worker_id:
                 continue  # a newer epoch landed during the heartbeat
+            # the fencing grant precedes the engine start: the engines'
+            # first produce/commit must already carry this epoch's token
+            rt.fence.grant(tid, self.epoch)
             await rt.adopt_tenant(cfg)
             if self.assignment.get(tid) != self.worker_id:
                 # the epoch moved this tenant away while our engines
@@ -230,8 +272,11 @@ class FleetWorker(LifecycleComponent):
                 # assignee may already be waiting on our release (and
                 # one that adopted through a prev-owner-free view
                 # overlaps us until this lands; delivery stays
-                # at-least-once through the shared consumer group)
+                # at-least-once through the shared consumer group,
+                # and the fence authority keeps US the allowed writer
+                # until this release record lands)
                 await rt.release_tenant(tid)
+                rt.fence.revoke(tid)
                 await rt.bus.produce(self.control_topic, {
                     "kind": "release", "worker": self.worker_id,
                     "tenant": tid, "epoch": self.epoch,
@@ -277,6 +322,7 @@ class FleetWorker(LifecycleComponent):
             for tid in sorted(self.owned):
                 await self.runtime.release_tenant(tid)
                 self.owned.discard(tid)
+                self.runtime.fence.revoke(tid)
                 await self.runtime.bus.produce(self.control_topic, {
                     "kind": "release", "worker": self.worker_id,
                     "tenant": tid, "epoch": self.epoch,

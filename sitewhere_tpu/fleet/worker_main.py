@@ -17,6 +17,16 @@ scoring-pipeline services (the colocation set the split topology
 proved: device-mgmt, inbound, event-mgmt, device-state,
 rule-processing), attaches a `FleetWorker`, and runs until SIGTERM/
 SIGINT or until the controller retires the worker.
+
+Hermetic by default: tenant registry state replicates over the bus
+(the per-tenant registry-state topic, services/replication.py), so a
+worker needs NOTHING but the wire broker to adopt a tenant — no shared
+filesystem. A `data_dir`, when given, is worker-LOCAL (registry WAL +
+snapshots for single-node restart; event-history spill), never shared.
+Every data-path produce/commit carries the placement epoch fencing
+token; a worker whose writes are rejected (it was declared dead while
+stalled) stops the tenant's engines instead of retrying
+(docs/FLEET.md fencing protocol).
 """
 
 from __future__ import annotations
@@ -61,11 +71,17 @@ def build_runtime(cfg: dict):
         injector = FaultInjector(seed=int(chaos.get("seed", 0)))
         sites = chaos.get("sites") or {}
         # literal site names only (FLT01: the registry vouches for
-        # literals) — the worker-side chaos surface is the heartbeat
-        # loop; bus.poll rides the broker process, not this one
+        # literals) — the worker-side chaos surfaces are the heartbeat
+        # loop and the replay-on-adopt path; bus.poll rides the broker
+        # process, not this one
         spec = sites.get("fleet.heartbeat")
         if spec:
             injector.arm("fleet.heartbeat",
+                         rate=float(spec.get("rate", 1.0)),
+                         max_faults=int(spec.get("max_faults", -1)))
+        spec = sites.get("fence.adopt")
+        if spec:
+            injector.arm("fence.adopt",
                          rate=float(spec.get("rate", 1.0)),
                          max_faults=int(spec.get("max_faults", -1)))
         rt.install_faults(injector)

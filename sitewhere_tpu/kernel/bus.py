@@ -44,6 +44,133 @@ def key_hash(key: str) -> int:
     return zlib.crc32(key.encode())
 
 
+class FencedError(RuntimeError):
+    """A data-path write carried a stale fencing token: the tenant's
+    placement moved and this writer is no longer the owner.
+
+    The worker-side contract (docs/FLEET.md fencing protocol) is "stop
+    engines, do not retry": the write was REJECTED broker-side — a
+    zombie owner (false-positive death, SIGSTOP past `dead_after`)
+    cannot commit offsets or publish records for a tenant another
+    worker now owns. `tenant`/`epoch` carry the rejected token's
+    identity when known, so an asynchronously-surfacing rejection (a
+    fire-and-forget wire commit) can be matched against the CURRENT
+    grant — a stale rejection must not fence a legitimately
+    re-adopted tenant."""
+
+    def __init__(self, message: str, tenant: Optional[str] = None,
+                 epoch: Optional[int] = None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.epoch = epoch
+
+
+# fencing watches the fleet-control topic for placement/release records
+# (TopicNaming.FLEET_CONTROL under the instance scope)
+_FLEET_CONTROL_SUFFIX = ".instance.fleet-control"
+
+
+class FenceAuthority:
+    """Broker-side fencing truth: which worker may write each tenant's
+    data path (one per `EventBus`, built lazily from the fleet-control
+    records that already flow through the broker).
+
+    The token a fleet worker threads on every data-path produce/commit
+    is `[tenant, epoch, worker]` — epoch is the placement epoch at which
+    the worker adopted. Ownership transfers mirror the worker-side
+    drain-then-handoff protocol exactly:
+
+    - a placement that KEEPS a tenant's owner re-affirms it;
+    - a placement that MOVES a tenant whose old owner is still in the
+      record's live-worker list leaves the old owner fenced-IN until its
+      release record lands (the drain's final commits must pass);
+    - a placement that moves a tenant whose old owner is absent from the
+      live list (declared dead, left) fences the old owner IMMEDIATELY —
+      this is the zombie window the grace timers used to merely shrink,
+      closed by construction: the SIGCONT'd worker's first write is
+      rejected, not tolerated.
+
+    Writes with NO token pass (ingress edges, non-fleet runtimes, the
+    control plane itself); the FEN01 lint contract is what guarantees
+    fleet-managed tenant modules always present one."""
+
+    __slots__ = ("owners", "pending", "rejections")
+
+    def __init__(self) -> None:
+        self.owners: dict[str, tuple[str, int]] = {}   # tenant -> (worker, epoch)
+        self.pending: dict[str, tuple[str, int]] = {}  # awaiting old owner's release
+        self.rejections = 0
+
+    def observe(self, value) -> None:
+        """Fold one fleet-control record into the ownership table.
+
+        The grant rule must mirror the worker-side `_adoptable` EXACTLY
+        (fleet/worker.py), keyed off the placement record's `prev` map —
+        the controller's best-known ACTUAL owners, not the assignment:
+        an assignment that moved again before its first assignee ever
+        adopted must not leave the authority waiting on a release from
+        a worker that never owned the tenant (measured: that divergence
+        fenced a legitimate replacement adopter in an adopt→fence→
+        release loop and wedged the tenant)."""
+        kind = value.get("kind") if isinstance(value, dict) else None
+        if kind == "placement":
+            epoch = int(value.get("epoch", -1))
+            assignment = value.get("assignment") or {}
+            prev = value.get("prev") or {}
+            live = set(value.get("workers") or ())
+            for tenant, worker in assignment.items():
+                actual = prev.get(tenant)
+                if actual is None or actual == worker \
+                        or actual not in live:
+                    # exactly the adopter's immediate-adopt cases: the
+                    # tenant is owner-free, kept, or its owner is dead/
+                    # left (a corpse can't ack — and a ZOMBIE corpse's
+                    # next write must be rejected, which this transfer
+                    # is what guarantees)
+                    self.owners[tenant] = (worker, epoch)
+                    self.pending.pop(tenant, None)
+                else:
+                    # live actual owner: it is draining — its final
+                    # commits must pass until its release record lands
+                    self.owners[tenant] = (actual,
+                                           self.owners.get(tenant,
+                                                           (actual,
+                                                            epoch))[1])
+                    self.pending[tenant] = (worker, epoch)
+            for tenant in [t for t in self.owners if t not in assignment]:
+                # tenant left the placement (deleted): nothing to fence
+                self.owners.pop(tenant, None)
+                self.pending.pop(tenant, None)
+        elif kind == "release":
+            tenant = value.get("tenant")
+            worker = value.get("worker")
+            cur = self.owners.get(tenant)
+            nxt = self.pending.get(tenant)
+            if cur is not None and cur[0] == worker and nxt is not None:
+                # the draining owner finished: promote the adopter
+                self.owners[tenant] = nxt
+                self.pending.pop(tenant, None)
+
+    def check(self, token) -> None:
+        """Validate a data-path fencing token; raises FencedError."""
+        try:
+            tenant, epoch, worker = token
+        except (TypeError, ValueError):
+            raise FencedError(f"malformed fence token {token!r}") from None
+        cur = self.owners.get(tenant)
+        if cur is None or worker == cur[0]:
+            # unknown tenant (fencing not established) or the allowed
+            # writer — same-worker tokens pass across epochs: ownership
+            # never changed hands, so there is no zombie to reject
+            return
+        self.rejections += 1
+        raise FencedError(
+            f"fenced: tenant {tenant!r} write from {worker!r} (adopted at "
+            f"epoch {epoch}) rejected — epoch {cur[1]} placed it on "
+            f"{cur[0]!r}; this writer is no longer the owner (stop "
+            f"engines, do not retry)", tenant=tenant, epoch=epoch)
+
+
 @dataclass(frozen=True, slots=True)
 class TopicRecord:
     """One record as seen by a consumer (analog of ConsumerRecord)."""
@@ -140,6 +267,13 @@ class EventBus(LifecycleComponent):
         # chaos seam (kernel/faults.py): None in production — produce/
         # poll consult the armed sites only when an injector is installed
         self.faults = None
+        # epoch fencing (docs/FLEET.md): built lazily from the first
+        # fleet-control placement record to flow through this broker;
+        # None on non-fleet buses — the hot path pays one suffix test
+        self.fences: Optional[FenceAuthority] = None
+        # optional metrics registry (set by the runtime that OWNS this
+        # bus) so fenced rejections surface as `fence.rejections`
+        self.metrics = None
 
     # -- admin -------------------------------------------------------------
 
@@ -204,6 +338,26 @@ class EventBus(LifecycleComponent):
             return out
         return out[-limit:] if limit else []  # out[-0:] would be ALL
 
+    # -- fencing -----------------------------------------------------------
+
+    def check_fence(self, fence) -> None:
+        """Validate a data-path fencing token against the live placement
+        (no-op without a token or before any placement was seen)."""
+        if fence is not None and self.fences is not None:
+            try:
+                self.fences.check(fence)
+            except FencedError:
+                if self.metrics is not None:
+                    self.metrics.counter("fence.rejections").inc()
+                raise
+
+    def _observe_control(self, value) -> None:
+        kind = value.get("kind") if isinstance(value, dict) else None
+        if kind in ("placement", "release"):
+            if self.fences is None:
+                self.fences = FenceAuthority()
+            self.fences.observe(value)
+
     # -- produce -----------------------------------------------------------
 
     def _select_partition(self, topic: _Topic, key: Optional[str]) -> int:
@@ -214,10 +368,17 @@ class EventBus(LifecycleComponent):
 
     async def produce(self, topic_name: str, value: Any, *,
                       key: Optional[str] = None,
-                      partition: Optional[int] = None) -> tuple[int, int]:
-        """Append a record; returns (partition, offset)."""
+                      partition: Optional[int] = None,
+                      fence=None) -> tuple[int, int]:
+        """Append a record; returns (partition, offset). `fence` is the
+        data-path fencing token a fleet tenant owner threads
+        (`[tenant, epoch, worker]`) — a stale token raises FencedError
+        BEFORE anything is appended."""
         if self.faults is not None:
             await self.faults.acheck("bus.produce")
+        self.check_fence(fence)
+        if topic_name.endswith(_FLEET_CONTROL_SUFFIX):
+            self._observe_control(value)
         self.create_topic(topic_name)
         topic = self._topics[topic_name]
         p = partition if partition is not None else self._select_partition(topic, key)
@@ -230,11 +391,15 @@ class EventBus(LifecycleComponent):
 
     def produce_nowait(self, topic_name: str, value: Any, *,
                        key: Optional[str] = None,
-                       partition: Optional[int] = None) -> tuple[int, int]:
+                       partition: Optional[int] = None,
+                       fence=None) -> tuple[int, int]:
         """Synchronous append for non-async producers (e.g. bench loops).
 
         Waiting consumers are woken via call_soon on the running loop if any.
         """
+        self.check_fence(fence)
+        if topic_name.endswith(_FLEET_CONTROL_SUFFIX):
+            self._observe_control(value)
         self.create_topic(topic_name)
         topic = self._topics[topic_name]
         p = partition if partition is not None else self._select_partition(topic, key)
@@ -409,13 +574,18 @@ class BusConsumer:
             records = self.poll_nowait(max_records)
         return records
 
-    def commit(self, positions: Optional[dict[tuple[str, int], int]] = None) -> None:
+    def commit(self, positions: Optional[dict[tuple[str, int], int]] = None,
+               *, fence=None) -> None:
         """Commit positions to the group (next-offset convention).
 
         With `positions` (a snapshot from `snapshot_positions()`), commits
         exactly those offsets — the checkpointed-commit pattern: snapshot
         when the processing pipeline is empty, commit once everything
-        dispatched before the snapshot has been published."""
+        dispatched before the snapshot has been published. `fence` is the
+        data-path fencing token (see `EventBus.produce`): a stale-epoch
+        commit raises FencedError and advances NOTHING — a zombie owner
+        can never move a tenant group's offsets."""
+        self._bus.check_fence(fence)
         state = self._bus._groups[self.group]
         src = positions if positions is not None else self._positions
         for tp, pos in src.items():
@@ -425,6 +595,14 @@ class BusConsumer:
 
     def snapshot_positions(self) -> dict[tuple[str, int], int]:
         """Current read positions (for a deferred checkpointed commit)."""
+        return dict(self._positions)
+
+    def delivered_positions(self) -> dict[tuple[str, int], int]:
+        """Synchronous copy of delivered-through positions — same as
+        `snapshot_positions` in-proc; exists so callers that must stay
+        sync (a cancelled loop's finally, the clean-handoff
+        commit-through) have one name that works on the remote
+        consumer too (whose `snapshot_positions` is a coroutine)."""
         return dict(self._positions)
 
     def seek_to_beginning(self) -> None:
@@ -462,6 +640,10 @@ class TopicNaming:
     SCORED_EVENTS = "scored-events"              # new: model-plane output
     DEAD_LETTER = "dead-letter-events"           # poison-record quarantine
     DEFERRED_EVENTS = "deferred-events"          # overload spool (flow.py)
+    REGISTRY_STATE = "registry-state"            # replicated tenant state
+    #   (services/replication.py: device-registry mutations + interleaved
+    #    snapshot records — what a hermetic adopter replays instead of a
+    #    shared-filesystem registry.snap)
     # instance-scoped
     TENANT_MODEL_UPDATES = "tenant-model-updates"
     INSTANCE_LOGS = "instance-logs"

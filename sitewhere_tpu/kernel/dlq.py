@@ -57,11 +57,13 @@ def _trace_of(value) -> tuple[int, int]:
 async def quarantine(bus, dlq_topic: str, record, exc: BaseException,
                      stage: str, metrics=None,
                      tenant_id: Optional[str] = None,
-                     tracer=None) -> None:
+                     tracer=None, fence=None) -> None:
     """Publish a poison record to the tenant's dead-letter topic.
 
     Never raises: a DLQ publish failure is logged and counted — the
-    consuming loop must keep draining either way."""
+    consuming loop must keep draining either way. `fence` is the
+    data-path fencing token (kernel/bus.py): a zombie owner's
+    quarantine publish is rejected like any other data-path write."""
     t0 = time.monotonic()
     entry = {
         "original_topic": record.topic,
@@ -74,7 +76,7 @@ async def quarantine(bus, dlq_topic: str, record, exc: BaseException,
         "quarantined_at": time.time(),
     }
     try:
-        await bus.produce(dlq_topic, entry, key=record.key)
+        await bus.produce(dlq_topic, entry, key=record.key, fence=fence)
     except Exception:  # noqa: BLE001 - quarantine must not re-poison the loop
         logger.exception("dead-letter publish to %s failed for %s@%d",
                          dlq_topic, record.topic, record.offset)
@@ -109,7 +111,7 @@ async def replay_dead_letters(bus, dlq_topic: str, *,
                               limit: Optional[int] = None,
                               metrics=None, flow=None,
                               tenant_id: Optional[str] = None,
-                              tracer=None) -> int:
+                              tracer=None, fence=None) -> int:
     """Re-produce dead letters onto their original topics; returns the
     count replayed. Progress is committed under a per-topic replay
     group, so a second replay call continues where the last stopped.
@@ -146,7 +148,7 @@ async def replay_dead_letters(bus, dlq_topic: str, *,
                         break   # NOT committed: the next replay resumes here
                 t0 = time.monotonic()
                 await bus.produce(entry["original_topic"], entry["value"],
-                                  key=entry.get("key"))
+                                  key=entry.get("key"), fence=fence)
                 replayed += 1
                 if tracer is not None:
                     # replay re-enters the pipeline under the SAME trace
@@ -157,7 +159,7 @@ async def replay_dead_letters(bus, dlq_topic: str, *,
                                   tenant_id or "", t0,
                                   time.monotonic() - t0, n)
             # else: foreign record on the DLQ topic — skip, still commit
-            consumer.commit()
+            consumer.commit(fence=fence)
     finally:
         consumer.close()
     if replayed and metrics is not None:

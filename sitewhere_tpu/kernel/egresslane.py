@@ -237,9 +237,13 @@ class EgressStage:
                 and self.engine.runtime.faults is None):
             # sync fast path: publish now (ordering holds — this shard
             # has nothing unpublished ahead), alert emission still rides
-            # the shard loop off the flush path
+            # the shard loop off the flush path. A FencedError here
+            # (zombie owner) also falls through: the shard's awaited
+            # produce re-raises it into the dead_letter hook, which
+            # reports the ownership loss instead of quarantining
             try:
-                self._produce_nowait(self.scored_topic, scored, key=key)
+                self._produce_nowait(self.scored_topic, scored, key=key,
+                                     fence=self.engine.fence_token())
             except Exception:  # noqa: BLE001 - shard path quarantines
                 pass  # fall through: the shard publishes (or DLQs) it
             else:
@@ -309,7 +313,8 @@ class EgressShard(BackgroundTaskComponent):
                             await runtime.faults.acheck("egress.publish")
                         await bus.produce(stage.scored_topic, scored,
                                           key=getattr(scored.ctx,
-                                                      "source", None))
+                                                      "source", None),
+                                          fence=engine.fence_token())
                     except asyncio.CancelledError:
                         # shutdown mid-publish: put the batch back so
                         # the stop-path drain (or a restart) finishes

@@ -74,9 +74,12 @@ from sitewhere_tpu.domain.batch import (
     MeasurementBatch,
     RegistrationBatch,
 )
-from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import commit_barrier
-from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.lifecycle import (
+    BackgroundTaskComponent,
+    LifecycleStatus,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -110,8 +113,8 @@ def fastlane_enabled(tenant, runtime) -> bool:
 
 
 async def checkpoint_commit(consumer, sink,
-                            ckpt: Optional[tuple[int, dict]]
-                            ) -> Optional[tuple[int, dict]]:
+                            ckpt: Optional[tuple[int, dict]],
+                            fence=None) -> Optional[tuple[int, dict]]:
     """One at-least-once commit step, shared by the fused fast lane and
     the staged rule processor (one implementation so the lanes cannot
     diverge on the barrier): when the sink is idle, commit directly;
@@ -119,13 +122,24 @@ async def checkpoint_commit(consumer, sink,
     sits unflushed and commit that snapshot once every flush dispatched
     before it has settled AND published (`settled_through` barrier).
     Returns the new checkpoint. A crash redelivers at most the
-    unsettled tail."""
-    if sink is None or sink.idle:
-        consumer.commit()
-        return None
-    if ckpt is not None and sink.settled_through >= ckpt[0]:
-        consumer.commit(ckpt[1])
-        ckpt = None
+    unsettled tail.
+
+    `fence` is the engine's TenantFence handle (kernel/service.py): the
+    commit threads the live `[tenant, epoch, worker]` token, and a
+    broker rejection (FencedError — this worker lost the tenant) is
+    reported back instead of retried: the offsets stay untouched for
+    the new owner, and the fleet worker stops these engines."""
+    tok = fence.token() if fence is not None else None
+    try:
+        if sink is None or sink.idle:
+            consumer.commit(fence=tok)
+            return None
+        if ckpt is not None and sink.settled_through >= ckpt[0]:
+            consumer.commit(ckpt[1], fence=tok)
+            ckpt = None
+    except FencedError:
+        fence.lost()
+        return ckpt
     if ckpt is None and sink.pending_n == 0:
         snap = consumer.snapshot_positions()
         if inspect.isawaitable(snap):
@@ -141,12 +155,13 @@ async def checkpoint_commit(consumer, sink,
 # lanes record it around this call on the same record) — a second span
 # here would double-count the validate work in the critical path.
 async def validate_and_split(batch, dm, runtime, unregistered_topic,  # swxlint: disable=FLW01,TRC01
-                             dropped):
+                             dropped, fence=None):
     """The registration-mask validation BOTH lanes share: gather the
     mask, split unregistered devices to the unregistered-device topic,
     return the selected batch (the input object when nothing split).
     One implementation so the lanes cannot diverge on the validation
-    contract the equivalence tests defend."""
+    contract the equivalence tests defend. `fence` is the caller
+    engine's data-path fencing token (kernel/bus.py)."""
     mask = dm.registered_mask(batch.device_index)
     if inspect.isawaitable(mask):
         mask = await mask  # device-mgmt in a peer process (staged lane)
@@ -156,7 +171,7 @@ async def validate_and_split(batch, dm, runtime, unregistered_topic,  # swxlint:
         await runtime.bus.produce(
             unregistered_topic,
             {"device_indices": batch.device_index[~mask],
-             "ctx": batch.ctx})
+             "ctx": batch.ctx}, fence=fence)
         batch = batch.select(mask)
     return batch
 
@@ -224,6 +239,11 @@ class FastLane(BackgroundTaskComponent):
         # (kernel/egresslane.py): offsets wait for the PUBLISH, exactly
         # like the staged lane's rule processor
         barrier = commit_barrier(sink, engine.egress)
+        # handled-through frontier for the clean-handoff commit-through:
+        # positions as of the last FULLY handled poll batch — a
+        # cancellation mid-batch must not let the stop path commit past
+        # records this loop never produced/admitted
+        handled = None
         cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
         if not cap and engine.pool_slot is not None:
             cap = engine.pool_slot.pool.cfg.backlog_events
@@ -276,6 +296,8 @@ class FastLane(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
+                if records:
+                    handled = consumer.delivered_positions()
                 if sink is not None and sink.flush_due:
                     # pipelined: dispatch now; settle/publish runs via the
                     # scored sink without blocking this consumer loop.
@@ -284,9 +306,19 @@ class FastLane(BackgroundTaskComponent):
                     # delegate to the shared megabatch round, so consumer
                     # turns drive the stacked dispatch cadence too.
                     sink.flush_nowait()
-                ckpt = await checkpoint_commit(consumer, barrier, ckpt)
+                ckpt = await checkpoint_commit(consumer, barrier, ckpt,
+                                               fence=engine.fence)
         finally:
-            consumer.close()
+            if engine.status == LifecycleStatus.STOPPING:
+                # engine stop (release/handoff): the engine's _do_stop
+                # commits the handled-through positions once the drain
+                # proves them settled AND published — the clean handoff
+                # then replays nothing (exactly-once) — and closes it
+                engine._stopped_consumers.append((consumer, handled))
+            else:
+                # supervised restart: leave the group so the fresh
+                # consumer's join rebalances cleanly
+                consumer.close()
 
     async def _handle(self, record, dm, sink) -> None:
         """One record through the fused path: fair admission → mask
@@ -313,7 +345,7 @@ class FastLane(BackgroundTaskComponent):
         if isinstance(batch, (MeasurementBatch, LocationBatch)):
             batch = await validate_and_split(
                 batch, dm, runtime, self._unregistered_topic,
-                self._dropped)
+                self._dropped, fence=engine.fence_token())
             if len(batch):
                 self._processed.mark(len(batch))
                 # flag BEFORE the inbound produce: the rule-processing
@@ -321,7 +353,8 @@ class FastLane(BackgroundTaskComponent):
                 # (hooks, deferred replay) and must not re-admit it
                 batch.ctx.fastlane = True
                 await runtime.bus.produce(self._inbound_topic, batch,
-                                          key=record.key)
+                                          key=record.key,
+                                          fence=engine.fence_token())
                 if sink is not None and isinstance(batch, MeasurementBatch):
                     # the fused scoring admit — the work the slow lane
                     # does two bus hops later, routed by the SAME shed
@@ -337,6 +370,7 @@ class FastLane(BackgroundTaskComponent):
         elif isinstance(batch, RegistrationBatch):
             # registration stays on the staged path: hand it to the
             # device-registration consumer exactly like the slow lane
-            await runtime.bus.produce(self._unregistered_topic, batch)
+            await runtime.bus.produce(self._unregistered_topic, batch,
+                                      fence=engine.fence_token())
         else:
             logger.warning("fastlane: unknown record %r", type(batch))

@@ -30,7 +30,7 @@ import logging
 from typing import Any, Optional
 
 from sitewhere_tpu.config import InstanceSettings, TenantConfig
-from sitewhere_tpu.kernel.bus import EventBus, TopicNaming
+from sitewhere_tpu.kernel.bus import EventBus, FencedError, TopicNaming
 from sitewhere_tpu.kernel.lifecycle import (
     BackgroundTaskComponent,
     LifecycleComponent,
@@ -42,6 +42,97 @@ from sitewhere_tpu.kernel.metrics import MetricsRegistry
 logger = logging.getLogger(__name__)
 
 
+class FenceState:
+    """Worker-side fencing ledger (one per ServiceRuntime).
+
+    A fleet worker's `FleetWorker` grants a `(tenant, epoch)` pair here
+    when it adopts a tenant and revokes it on release; every data-path
+    produce/commit the tenant's engines issue threads the resulting
+    `[tenant, epoch, worker]` token (the FEN01 lint contract), and the
+    broker's `FenceAuthority` validates it against the live placement.
+    On a rejection — synchronous FencedError or the wire client's
+    background `on_fenced` callback — `mark_fenced` records the loss and
+    notifies the worker, whose apply loop stops the tenant's engines
+    WITHOUT publishing a release (the fence already transferred
+    ownership; a zombie's release record would carry a stale epoch).
+
+    Non-fleet runtimes never grant anything, so `token()` is None and
+    every write stays unfenced (backward compatible by construction)."""
+
+    def __init__(self) -> None:
+        self.worker_id: Optional[str] = None
+        self._epochs: dict[str, int] = {}
+        self.lost: set[str] = set()
+        self.on_lost = None       # callback(tenant_id), set by FleetWorker
+
+    def grant(self, tenant_id: str, epoch: int) -> None:
+        self._epochs[tenant_id] = int(epoch)
+        self.lost.discard(tenant_id)
+
+    def revoke(self, tenant_id: str) -> None:
+        self._epochs.pop(tenant_id, None)
+        self.lost.discard(tenant_id)
+
+    def epoch(self, tenant_id: str) -> Optional[int]:
+        return self._epochs.get(tenant_id)
+
+    def token(self, tenant_id: str):
+        epoch = self._epochs.get(tenant_id)
+        if epoch is None or self.worker_id is None:
+            return None
+        return [tenant_id, epoch, self.worker_id]
+
+    def mark_fenced(self, tenant_id: Optional[str],
+                    epoch: Optional[int] = None) -> None:
+        """A broker rejected this process's write for `tenant_id`: we
+        are no longer the owner. Idempotent; safe from sync paths.
+        `epoch` is the REJECTED token's epoch when known (async wire
+        rejections): a rejection for an OLDER grant than the one we
+        currently hold is stale — the tenant was legitimately
+        re-adopted since, and fencing the fresh grant would wedge it
+        (no release published, no new epoch coming)."""
+        if not tenant_id or tenant_id not in self._epochs \
+                or tenant_id in self.lost:
+            return
+        current = self._epochs.get(tenant_id)
+        if epoch is not None and current is not None and epoch < current:
+            logger.info(
+                "fence: ignoring stale rejection for tenant %s (token "
+                "epoch %s < current grant %s)", tenant_id, epoch, current)
+            return
+        self.lost.add(tenant_id)
+        # rejections are COUNTED broker-side only (`fence.rejections`,
+        # EventBus.check_fence) — counting the worker-side demotion
+        # under the same name would conflate per-write rejections with
+        # once-per-tenant losses and double-count shared-registry
+        # topologies
+        logger.warning(
+            "fence: data-path write for tenant %s REJECTED (epoch %s, "
+            "worker %s) — ownership moved; stopping engines, not "
+            "retrying", tenant_id, self._epochs.get(tenant_id),
+            self.worker_id)
+        if self.on_lost is not None:
+            self.on_lost(tenant_id)
+
+
+class TenantFence:
+    """Per-tenant fencing handle data-path helpers thread around
+    (`checkpoint_commit` takes one): `token()` resolves the LIVE token
+    at call time, `lost()` reports a broker rejection back."""
+
+    __slots__ = ("_state", "_tenant")
+
+    def __init__(self, state: FenceState, tenant_id: str):
+        self._state = state
+        self._tenant = tenant_id
+
+    def token(self):
+        return self._state.token(self._tenant)
+
+    def lost(self) -> None:
+        self._state.mark_fenced(self._tenant)
+
+
 class TenantEngine(LifecycleComponent):
     """Per-tenant engine inside a service (reference: MicroserviceTenantEngine)."""
 
@@ -49,10 +140,30 @@ class TenantEngine(LifecycleComponent):
         super().__init__(f"tenant-{tenant.tenant_id}")
         self.service = service
         self.tenant = tenant
+        self._fence: Optional[TenantFence] = None
 
     @property
     def runtime(self) -> "ServiceRuntime":
         return self.service.runtime
+
+    # -- epoch fencing (docs/FLEET.md) --------------------------------------
+
+    @property
+    def fence(self) -> TenantFence:
+        """This tenant's fencing handle (for `checkpoint_commit`)."""
+        if self._fence is None:
+            self._fence = TenantFence(self.runtime.fence, self.tenant_id)
+        return self._fence
+
+    def fence_token(self):
+        """The live `[tenant, epoch, worker]` data-path token — None on
+        non-fleet runtimes, so unfenced writes stay unfenced."""
+        return self.runtime.fence.token(self.tenant_id)
+
+    def fence_lost(self) -> None:
+        """Report a synchronous FencedError: this worker lost the
+        tenant; the fleet worker's apply loop stops the engines."""
+        self.runtime.fence.mark_fenced(self.tenant_id)
 
     @property
     def tenant_id(self) -> str:
@@ -69,16 +180,26 @@ class TenantEngine(LifecycleComponent):
                           stage: str) -> None:
         """Quarantine a poison record to this tenant's dead-letter
         topic with provenance (kernel/dlq.py) — the per-record catch
-        every consuming loop routes through. Never raises."""
+        every consuming loop routes through. Never raises.
+
+        FencedError is NOT poison: the record is fine, THIS WORKER lost
+        the tenant (epoch fencing, docs/FLEET.md). Quarantining it would
+        both pollute the DLQ and commit past a record the new owner must
+        redeliver — instead the loss is recorded and the fleet worker
+        stops the engines; the record stays uncommitted for the owner."""
         from sitewhere_tpu.kernel.dlq import quarantine
 
+        if isinstance(exc, FencedError):
+            self.fence_lost()
+            return
         # the DLQ rate feeds the tenant's overload pressure: a poison
         # storm escalates shedding even before the scorer backlog builds
         self.runtime.flow.note_dead_letter(self.tenant_id)
         await quarantine(self.runtime.bus, self.dead_letter_topic, record,
                          exc, stage, metrics=self.runtime.metrics,
                          tenant_id=self.tenant_id,
-                         tracer=self.runtime.tracer)
+                         tracer=self.runtime.tracer,
+                         fence=self.fence_token())
 
 
 class Service(LifecycleComponent):
@@ -258,11 +379,24 @@ class ServiceRuntime(LifecycleComponent):
         if isinstance(self.bus, LifecycleComponent):
             if self.bus.parent is None:
                 self.add_child(self.bus)
+                # the owning runtime's registry counts broker-side
+                # fenced rejections (`fence.rejections`)
+                if hasattr(self.bus, "metrics"):
+                    self.bus.metrics = self.metrics
             # else: an in-proc bus another runtime already owns (the
             # in-proc fleet topology: N runtimes share one bus) — use
             # it, leave its lifecycle to the owning runtime
         else:
             self._external_bus = self.bus
+        # epoch fencing, worker side (docs/FLEET.md): the ledger of
+        # (tenant, epoch) grants this process holds. FleetWorker sets
+        # worker_id/on_lost; non-fleet runtimes never grant, so every
+        # token resolves to None and writes stay unfenced.
+        self.fence = FenceState()
+        if hasattr(self.bus, "on_fenced"):
+            # wire bus: a fire-and-forget commit/produce rejection
+            # surfaces through the client callback instead of a raise
+            self.bus.on_fenced = self.fence.mark_fenced
         # per-tenant flow control (kernel/flow.py): quotas, weighted-fair
         # inbound admission, overload shedding — every ingress edge and
         # the rule-processing shed path consult this

@@ -35,7 +35,7 @@ import time
 from typing import Any, Iterable, Optional
 
 from sitewhere_tpu.kernel import codec
-from sitewhere_tpu.kernel.bus import EventBus, TopicRecord
+from sitewhere_tpu.kernel.bus import EventBus, FencedError, TopicRecord
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +176,11 @@ class WireClient:
         # they are neither GC'd mid-flight nor silently raced by close();
         # `flush_background()` awaits them at orderly shutdown
         self._bg: set[asyncio.Task] = set()
+        # fencing notification for fire-and-forget paths: a background
+        # commit rejected with FencedError cannot raise into the caller,
+        # so the runtime registers a callback(tenant) here instead
+        # (ServiceRuntime wires it to FenceState.mark_fenced)
+        self.on_fenced = None
 
     async def connect(self, timeout: float = 10.0,
                       retry_interval: float = 0.2) -> None:
@@ -228,6 +233,14 @@ class WireClient:
         body = await fut
         msg = codec.decode(body)
         if "err" in msg:
+            if str(msg["err"]).startswith("FencedError:"):
+                # the broker rejected a stale-epoch data-path write:
+                # surface the DISTINCT error — with the rejected token's
+                # identity — so the worker treats it as "I am no longer
+                # the owner" instead of a retryable fault
+                tok = kwargs.get("fence") or [None, None]
+                raise FencedError(str(msg["err"]), tenant=tok[0],
+                                  epoch=tok[1] if len(tok) > 1 else None)
             raise RuntimeError(f"wire call {op} failed remotely: {msg['err']}")
         return msg["ok"]
 
@@ -239,8 +252,15 @@ class WireClient:
         def done(t: asyncio.Task) -> None:
             self._bg.discard(t)
             if not t.cancelled() and t.exception() is not None:
-                logger.debug("wire background call failed: %r",
-                             t.exception())
+                exc = t.exception()
+                if isinstance(exc, FencedError) and self.on_fenced is not None:
+                    # a fire-and-forget commit/produce was fenced: the
+                    # worker must learn it lost the tenant even though
+                    # no caller was awaiting this RPC. The rejected
+                    # token's epoch rides along so a LATE rejection of
+                    # an old grant can't fence a fresh re-adoption.
+                    self.on_fenced(exc.tenant, exc.epoch)
+                logger.debug("wire background call failed: %r", exc)
 
         task.add_done_callback(done)
         return task
@@ -298,9 +318,13 @@ class BusServer(WireServer):
         }
 
     async def _op_produce(self, msg, writer=None) -> tuple[int, int]:
+        # `fence` rides the op verbatim; the EventBus authority rejects
+        # stale-epoch writes and the FencedError travels back as the
+        # distinct error string the client re-raises typed
         return await self.bus.produce(msg["topic"], msg["value"],
                                       key=msg.get("key"),
-                                      partition=msg.get("partition"))
+                                      partition=msg.get("partition"),
+                                      fence=msg.get("fence"))
 
     async def _op_subscribe(self, msg, writer=None) -> int:
         consumer = self.bus.subscribe(msg["topics"], group=msg["group"],
@@ -324,7 +348,7 @@ class BusServer(WireServer):
         positions = msg.get("positions")
         if positions is not None:
             positions = {(t, p): off for t, p, off in positions}
-        self._consumers[msg["cid"]].commit(positions)
+        self._consumers[msg["cid"]].commit(positions, fence=msg.get("fence"))
         return True
 
     async def _op_positions(self, msg, writer=None) -> list:
@@ -403,17 +427,28 @@ class RemoteBusConsumer:
             out.append(TopicRecord(t, p, off, key, value, ts))
         return out
 
-    def commit(self, positions: Optional[dict] = None) -> None:
+    def commit(self, positions: Optional[dict] = None, *,
+               fence=None) -> None:
         if positions is None:
             positions = self._delivered
         rows = [[t, p, off] for (t, p), off in positions.items()]
+        # fire-and-forget: a FencedError resolves through the client's
+        # on_fenced callback (WireClient.spawn's done handler), since no
+        # caller awaits this RPC
         self._client.spawn(
-            self._client.call("commit", cid=self.cid, positions=rows))
+            self._client.call("commit", cid=self.cid, positions=rows,
+                              fence=fence))
 
     def snapshot_positions(self):
         # remote positions snapshot is async; expose the coroutine and
         # let checkpointing callers await it
         return self._snapshot()
+
+    def delivered_positions(self) -> dict:
+        """Synchronous copy of the CLIENT-side delivered-through map
+        (what a bare commit() would pin) — for callers that cannot
+        await (the clean-handoff commit-through)."""
+        return dict(self._delivered)
 
     async def _snapshot(self) -> dict:
         rows = await self._client.call("positions", cid=self.cid)
@@ -476,18 +511,33 @@ class RemoteEventBus:
         lag centrally (kernel/observe.py)."""
         return self._client.call("group_lags")
 
+    @property
+    def on_fenced(self):
+        """Callback(tenant) for fire-and-forget fenced rejections —
+        ServiceRuntime wires it to its FenceState so a background
+        commit/produce rejection still demotes the zombie owner."""
+        return self._client.on_fenced
+
+    @on_fenced.setter
+    def on_fenced(self, cb) -> None:
+        self._client.on_fenced = cb
+
     async def produce(self, topic: str, value: Any, *,
                       key: Optional[str] = None,
-                      partition: Optional[int] = None) -> tuple[int, int]:
+                      partition: Optional[int] = None,
+                      fence=None) -> tuple[int, int]:
         p, off = await self._client.call("produce", topic=topic, value=value,
-                                         key=key, partition=partition)
+                                         key=key, partition=partition,
+                                         fence=fence)
         return p, off
 
     def produce_nowait(self, topic: str, value: Any, *,
                        key: Optional[str] = None,
-                       partition: Optional[int] = None) -> None:
+                       partition: Optional[int] = None,
+                       fence=None) -> None:
         self._client.spawn(
-            self.produce(topic, value, key=key, partition=partition))
+            self.produce(topic, value, key=key, partition=partition,
+                         fence=fence))
 
     def subscribe(self, topics: Iterable[str] | str, *, group: str,
                   name: Optional[str] = None):
@@ -531,16 +581,17 @@ class _LazyRemoteConsumer(RemoteBusConsumer):
         else:
             super().seek_to_beginning()
 
-    def commit(self, positions: Optional[dict] = None) -> None:
+    def commit(self, positions: Optional[dict] = None, *,
+               fence=None) -> None:
         if self.cid >= 0:
-            super().commit(positions)
+            super().commit(positions, fence=fence)
         elif positions:
             # explicit positions before the first poll: subscribe first
             async def ensure_then_commit():
                 await self._ensure()
                 rows = [[t, p, off] for (t, p), off in positions.items()]
                 await self._client.call("commit", cid=self.cid,
-                                        positions=rows)
+                                        positions=rows, fence=fence)
 
             self._client.spawn(ensure_then_commit())
 

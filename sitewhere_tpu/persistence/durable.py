@@ -283,6 +283,118 @@ class DurableEventLog:
         return n
 
 
+# -- registry write-ahead log -----------------------------------------------
+
+_WAL_REC = struct.Struct("<II")  # len u32 | crc32(payload) u32
+
+
+class WriteAheadLog:
+    """Tiny WAL for registry mutations between snapshots.
+
+    Append = write + flush (the OS has it: a hard PROCESS kill loses
+    nothing past the LAST APPENDED RECORD — the crash bound the
+    snapshot interval can't give). Fsync is GROUP-COMMITTED: coalesced
+    to one per event-loop tick via call_soon, so a registration burst
+    (thousands of journaled mutations in one tight batch) pays ONE
+    device sync instead of one per mutation — a per-append fsync
+    measured long enough to starve the fleet heartbeat past
+    `dead_after` and get the worker falsely fenced, the exact failure
+    this subsystem exists to contain. Host power loss is bounded by
+    the last completed tick's fsync. Replay tolerates a torn tail (CRC
+    guard, same contract as SegmentLog); `reset()` truncates once a
+    snapshot covers every appended record
+    (services/device_management.py wires the snapshotter's on_saved
+    callback to it)."""
+
+    def __init__(self, path: str):
+        import asyncio as _asyncio
+
+        self._asyncio = _asyncio
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file = open(path, "ab")
+        self.appended = 0
+        self._fsync_pending = False
+
+    @property
+    def closed(self) -> bool:
+        return self._file is None
+
+    def append(self, payload: bytes) -> None:
+        if self._file is None:
+            # a closed WAL must fail LOUDLY through the caller's OSError
+            # handling, never as an AttributeError that escapes it
+            raise OSError(f"wal {self.path} is closed")
+        self._file.write(_WAL_REC.pack(len(payload), zlib.crc32(payload)))
+        self._file.write(payload)
+        self._file.flush()
+        self.appended += 1
+        self._schedule_fsync()
+
+    def _schedule_fsync(self) -> None:
+        if self._fsync_pending:
+            return
+        try:
+            loop = self._asyncio.get_running_loop()
+        except RuntimeError:
+            self._fsync()  # no loop (thread/test context): sync now
+            return
+        self._fsync_pending = True
+        loop.call_soon(self._fsync)
+
+    def _fsync(self) -> None:
+        self._fsync_pending = False
+        if self._file is not None:
+            try:
+                os.fsync(self._file.fileno())
+            except OSError:
+                logger.warning("wal %s: fsync failed", self.path,
+                               exc_info=True)
+
+    def replay(self) -> list[bytes]:
+        """Every well-formed record, oldest first; a torn/corrupt tail
+        ends replay (the in-flight append a crash interrupted)."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        mv = memoryview(data)
+        out: list[bytes] = []
+        off = 0
+        while off + _WAL_REC.size <= len(mv):
+            ln, crc = _WAL_REC.unpack_from(mv, off)
+            start = off + _WAL_REC.size
+            end = start + ln
+            if end > len(mv):
+                logger.warning("wal %s: torn record at +%d — truncating "
+                               "replay", self.path, off)
+                break
+            payload = bytes(mv[start:end])
+            if zlib.crc32(payload) != crc:
+                logger.warning("wal %s: CRC mismatch at +%d — truncating "
+                               "replay", self.path, off)
+                break
+            out.append(payload)
+            off = end
+        return out
+
+    def reset(self) -> None:
+        """Drop every record (a snapshot now covers them all)."""
+        if self._file is None:
+            raise OSError(f"wal {self.path} is closed")
+        self._file.truncate(0)
+        self._file.seek(0)
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._fsync()  # settle any group-committed tail
+            self._file.close()
+            self._file = None  # type: ignore[assignment]
+
+
 # -- entity snapshots -------------------------------------------------------
 
 _SNAP = struct.Struct("<II")  # len u32 | crc32 u32

@@ -64,11 +64,14 @@ def _page(items: list, page: int, page_size: int) -> list:
 
 
 class _EntityTable:
-    """id + token indexed table for one entity type."""
+    """id + token indexed table for one entity type. `name` + the
+    3-arg `on_mutate(op, table, entity)` feed the mutation journal
+    (replicated tenant state, services/replication.py)."""
 
-    def __init__(self, on_mutate=None) -> None:
+    def __init__(self, on_mutate=None, name: str = "") -> None:
         self.by_id: dict[str, object] = {}
         self.by_token: dict[str, str] = {}
+        self.name = name
         self._on_mutate = on_mutate
 
     def put(self, entity) -> object:
@@ -76,7 +79,7 @@ class _EntityTable:
         if entity.token:
             self.by_token[entity.token] = entity.id
         if self._on_mutate is not None:
-            self._on_mutate()
+            self._on_mutate("put", self.name, entity)
         return entity
 
     def get(self, id: str):
@@ -91,7 +94,7 @@ class _EntityTable:
         if entity is not None and getattr(entity, "token", ""):
             self.by_token.pop(entity.token, None)
         if entity is not None and self._on_mutate is not None:
-            self._on_mutate()
+            self._on_mutate("del", self.name, entity)
         return entity
 
     def values(self) -> list:
@@ -103,16 +106,36 @@ class _TableSnapshotMixin:
     the `_EntityTable` attributes snapshotted/restored as a unit, and
     `mutations` is the debounce epoch (persistence/durable.py snapshots
     via services/snapshot.StoreSnapshotter). Restore merges by id and
-    rebuilds token indexes; subclasses extend for derived state."""
+    rebuilds token indexes; subclasses extend for derived state.
+
+    Replication hooks (services/replication.py): `journal`, when set,
+    receives `(seq, op, table, entity)` for every entity write/delete —
+    the mutation stream the WAL and the per-tenant registry-state topic
+    carry; `apply_journal` replays one such record (raw table writes,
+    NO journaling, no derived-index maintenance — callers reindex once
+    after the full replay). Snapshots carry `seq` (= `mutations` at
+    collect time) so replay from any source is bounded: only records
+    with a newer seq apply."""
 
     _TABLES: tuple = ()
     mutations: int = 0
+    journal = None     # callable(seq, op, table, entity) | None
+
+    def _mutated(self, op: str = "", table: str = "", entity=None) -> None:
+        self.mutations += 1
+        cb = self.journal
+        if cb is not None and op:
+            cb(self.mutations, op, table, entity)
 
     def _bump_mutations(self) -> None:
-        self.mutations += 1
+        # info-free mutation (derived/dict-only state): bumps the
+        # snapshot debounce epoch but emits no journal record — the
+        # next interleaved snapshot carries the change
+        self._mutated()
 
     def to_snapshot(self) -> dict:
-        return {"tables": {name: list(getattr(self, name).by_id.values())
+        return {"seq": self.mutations,
+                "tables": {name: list(getattr(self, name).by_id.values())
                            for name in self._TABLES}}
 
     def restore_snapshot(self, snap: dict) -> None:
@@ -122,6 +145,21 @@ class _TableSnapshotMixin:
                 table.by_id[entity.id] = entity
                 if getattr(entity, "token", ""):
                     table.by_token[entity.token] = entity.id
+        self.mutations = max(self.mutations, int(snap.get("seq", 0)))
+
+    def apply_journal(self, op: str, table: str, entity) -> None:
+        """Replay one journaled mutation (replicated-state adoption)."""
+        t = getattr(self, table, None)
+        if not isinstance(t, _EntityTable):
+            return
+        if op == "put":
+            t.by_id[entity.id] = entity
+            if getattr(entity, "token", ""):
+                t.by_token[entity.token] = entity.id
+        elif op == "del":
+            t.by_id.pop(entity.id, None)
+            if getattr(entity, "token", ""):
+                t.by_token.pop(entity.token, None)
 
 
 class InMemoryDeviceManagement(_TableSnapshotMixin):
@@ -138,19 +176,21 @@ class InMemoryDeviceManagement(_TableSnapshotMixin):
                "assignments", "groups", "customers", "areas", "zones")
 
     def __init__(self) -> None:
-        # mutation epoch (mixin): bumped on every entity write/delete —
-        # the snapshotter's "anything changed since last save?" signal
-        bump = self._bump_mutations
-        self.device_types = _EntityTable(bump)
-        self.commands = _EntityTable(bump)
-        self.statuses = _EntityTable(bump)
-        self.devices = _EntityTable(bump)
-        self.assignments = _EntityTable(bump)
-        self.groups = _EntityTable(bump)
+        # mutation epoch + journal (mixin): every entity write/delete
+        # bumps the snapshotter's debounce epoch AND, when a journal is
+        # attached (replicated tenant state), emits a (seq, op, table,
+        # entity) record the WAL / registry-state topic carry
+        mut = self._mutated
+        self.device_types = _EntityTable(mut, "device_types")
+        self.commands = _EntityTable(mut, "commands")
+        self.statuses = _EntityTable(mut, "statuses")
+        self.devices = _EntityTable(mut, "devices")
+        self.assignments = _EntityTable(mut, "assignments")
+        self.groups = _EntityTable(mut, "groups")
         self.group_elements: dict[str, list[DeviceGroupElement]] = {}
-        self.customers = _EntityTable(bump)
-        self.areas = _EntityTable(bump)
-        self.zones = _EntityTable(bump)
+        self.customers = _EntityTable(mut, "customers")
+        self.areas = _EntityTable(mut, "areas")
+        self.zones = _EntityTable(mut, "zones")
         self._next_index = 0
         self._token_to_index: dict[str, int] = {}
         self._index_to_device_id: dict[int, str] = {}
@@ -172,13 +212,19 @@ class InMemoryDeviceManagement(_TableSnapshotMixin):
         status; device index maps from the entities themselves.
         Idempotent: derived maps are rebuilt from scratch so an engine
         restart() re-running initialization never duplicates entries."""
-        self._token_to_index = {}
-        self._index_to_device_id = {}
-        self._active_assignment_by_device = {}
         super().restore_snapshot(snap)
         self.group_elements = {gid: list(els) for gid, els
                                in snap.get("group_elements", {}).items()}
         self._next_index = int(snap.get("next_index", 0))
+        self.reindex()
+
+    def reindex(self) -> None:
+        """Rebuild every derived map from entity contents — after a
+        snapshot restore AND after a journal replay (apply_journal
+        writes raw tables only, so one reindex covers any mix)."""
+        self._token_to_index = {}
+        self._index_to_device_id = {}
+        self._active_assignment_by_device = {}
         for d in self.devices.by_id.values():
             if d.token:
                 self._token_to_index[d.token] = d.index
@@ -188,6 +234,14 @@ class InMemoryDeviceManagement(_TableSnapshotMixin):
             if a.status == DeviceAssignmentStatus.ACTIVE:
                 self._active_assignment_by_device.setdefault(
                     a.device_id, []).append(a.id)
+
+    def apply_journal(self, op: str, table: str, entity) -> None:
+        if op == "gel":
+            # group-element append: `table` is the group id, `entity`
+            # the appended element list (add_device_group_elements)
+            self.group_elements.setdefault(table, []).extend(entity)
+            return
+        super().apply_journal(op, table, entity)
 
     # -- device types ------------------------------------------------------
 
@@ -360,9 +414,12 @@ class InMemoryDeviceManagement(_TableSnapshotMixin):
     def add_device_group_elements(self, group_id: str,
                                   elements: Sequence[DeviceGroupElement]) -> list[DeviceGroupElement]:
         stored = self.group_elements.setdefault(group_id, [])
-        for el in elements:
-            stored.append(dataclasses.replace(el, group_id=group_id))
-        self._bump_mutations()  # dict-only write: no _EntityTable involved
+        added = [dataclasses.replace(el, group_id=group_id)
+                 for el in elements]
+        stored.extend(added)
+        # dict-only write (no _EntityTable): journal the appended slice
+        # under the "gel" op so replicated adopters replay it too
+        self._mutated("gel", group_id, added)
         return list(stored)
 
     def list_device_group_elements(self, group_id: str) -> list[DeviceGroupElement]:
@@ -657,8 +714,8 @@ class InMemoryAssetManagement(_TableSnapshotMixin):
     _TABLES = ("asset_types", "assets")
 
     def __init__(self) -> None:
-        self.asset_types = _EntityTable(self._bump_mutations)
-        self.assets = _EntityTable(self._bump_mutations)
+        self.asset_types = _EntityTable(self._mutated, "asset_types")
+        self.assets = _EntityTable(self._mutated, "assets")
 
     def create_asset_type(self, at: AssetType) -> AssetType:
         return self.asset_types.put(at)
@@ -705,7 +762,7 @@ class InMemoryUserManagement(_TableSnapshotMixin):
     _TABLES = ("users",)
 
     def __init__(self) -> None:
-        self.users = _EntityTable(self._bump_mutations)
+        self.users = _EntityTable(self._mutated, "users")
 
     @staticmethod
     def _hash(password: str, salt: bytes) -> str:
@@ -751,7 +808,7 @@ class InMemoryTenantManagement(_TableSnapshotMixin):
     _TABLES = ("tenants",)
 
     def __init__(self) -> None:
-        self.tenants = _EntityTable(self._bump_mutations)
+        self.tenants = _EntityTable(self._mutated, "tenants")
 
     def create_tenant(self, tenant: Tenant) -> Tenant:
         return self.tenants.put(tenant)

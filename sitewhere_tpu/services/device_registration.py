@@ -34,7 +34,7 @@ from sitewhere_tpu.domain.batch import (
     RegistrationBatch,
 )
 from sitewhere_tpu.domain.model import Device, DeviceAssignment, DeviceType
-from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 
@@ -98,7 +98,12 @@ class RegistrationManager(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
-                consumer.commit()
+                try:
+                    consumer.commit(fence=engine.fence_token())
+                except FencedError:
+                    # ownership moved (epoch fencing): offsets stay for
+                    # the new owner; the fleet worker stops these engines
+                    engine.fence_lost()
         finally:
             consumer.close()
 

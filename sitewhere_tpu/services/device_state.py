@@ -17,7 +17,7 @@ import numpy as np
 
 from sitewhere_tpu.config import TenantConfig
 from sitewhere_tpu.domain.batch import LocationBatch, MeasurementBatch
-from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 
@@ -160,7 +160,12 @@ class StateMerger(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
-                consumer.commit()
+                try:
+                    consumer.commit(fence=engine.fence_token())
+                except FencedError:
+                    # ownership moved (epoch fencing): offsets stay for
+                    # the new owner; the fleet worker stops these engines
+                    engine.fence_lost()
         finally:
             consumer.close()
 

@@ -24,7 +24,7 @@ from sitewhere_tpu.domain.events import (
     DeviceCommandResponse,
     DeviceStateChange,
 )
-from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import egress_lanes
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
@@ -102,12 +102,14 @@ class EventManagementEngine(TenantEngine):
             self, invocations: Sequence[DeviceCommandInvocation]):
         """Persist invocations and publish them (command-delivery listens)."""
         out = self.spi.add_command_invocations(invocations)
-        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        await self.runtime.bus.produce(self._enriched_topic, list(out),
+                                       fence=self.fence_token())
         return out
 
     async def add_alerts(self, alerts: Sequence[DeviceAlert]):
         out = self.spi.add_alerts(alerts)
-        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        await self.runtime.bus.produce(self._enriched_topic, list(out),
+                                       fence=self.fence_token())
         return out
 
     async def add_command_responses(
@@ -115,12 +117,14 @@ class EventManagementEngine(TenantEngine):
         """Persist device command responses and republish (closes the
         command round trip: invoke → deliver → respond)."""
         out = self.spi.add_command_responses(responses)
-        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        await self.runtime.bus.produce(self._enriched_topic, list(out),
+                                       fence=self.fence_token())
         return out
 
     async def add_state_changes(self, changes: Sequence[DeviceStateChange]):
         out = self.spi.add_state_changes(changes)
-        await self.runtime.bus.produce(self._enriched_topic, list(out))
+        await self.runtime.bus.produce(self._enriched_topic, list(out),
+                                       fence=self.fence_token())
         return out
 
     def __getattr__(self, name):
@@ -170,16 +174,25 @@ class EventPersister(BackgroundTaskComponent):
                     try:  # swxlint: disable=DLQ01
                         await runtime.bus.produce(enriched_topic,
                                                   record.value,
-                                                  key=record.key)
+                                                  key=record.key,
+                                                  fence=engine.fence_token())
                     except asyncio.CancelledError:
                         raise
+                    except FencedError:
+                        # ownership moved: report it (the fleet worker
+                        # stops these engines) — counting it as an
+                        # enrich failure would mislabel a fencing event
+                        engine.fence_lost()
                     except Exception:  # noqa: BLE001 - counted, not poison
                         runtime.metrics.counter(
                             "event_management.enrich_publish_failures").inc()
                         logger.exception(
                             "event-mgmt[%s]: enriched re-publish failed; "
                             "batch persisted but not enriched", tenant_id)
-                consumer.commit()
+                try:
+                    consumer.commit(fence=engine.fence_token())
+                except FencedError:
+                    engine.fence_lost()
         finally:
             consumer.close()
 

@@ -25,7 +25,7 @@ from sitewhere_tpu.domain.batch import (
     MeasurementBatch,
     RegistrationBatch,
 )
-from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import egress_lanes
 from sitewhere_tpu.kernel.fastlane import fastlane_enabled, validate_and_split
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
@@ -120,12 +120,18 @@ class InboundProcessor(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
-                consumer.commit()
+                try:
+                    consumer.commit(fence=engine.fence_token())
+                except FencedError:
+                    # ownership moved (epoch fencing): offsets stay for
+                    # the new owner; the fleet worker stops these engines
+                    engine.fence_lost()
         finally:
             consumer.close()
 
     async def _handle(self, record, dm, runtime, tenant_id, inbound_topic,
                       unregistered_topic, processed, dropped) -> None:
+        engine = self.engine
         batch = record.value
         t_span = time.monotonic()
         if isinstance(batch, (MeasurementBatch, LocationBatch)):
@@ -139,16 +145,19 @@ class InboundProcessor(BackgroundTaskComponent):
                 # batch for enriched-hop admission.
                 ctx.fastlane = False
             batch = await validate_and_split(batch, dm, runtime,
-                                             unregistered_topic, dropped)
+                                             unregistered_topic, dropped,
+                                             fence=engine.fence_token())
             if len(batch):
                 processed.mark(len(batch))
                 await runtime.bus.produce(inbound_topic, batch,
-                                          key=record.key)
+                                          key=record.key,
+                                          fence=engine.fence_token())
             runtime.tracer.record(
                 batch.ctx.trace_id, "inbound.enrich", tenant_id,
                 t_span, time.monotonic() - t_span, len(batch))
         elif isinstance(batch, RegistrationBatch):
-            await runtime.bus.produce(unregistered_topic, batch)
+            await runtime.bus.produce(unregistered_topic, batch,
+                                      fence=engine.fence_token())
         else:
             logger.warning("inbound: unknown record %r", type(batch))
 

@@ -44,7 +44,7 @@ import numpy as np
 
 from sitewhere_tpu.config import TenantConfig
 from sitewhere_tpu.domain.batch import AlertBatch, MeasurementBatch, ScoredBatch
-from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import (
     EgressStage,
     commit_barrier,
@@ -56,7 +56,10 @@ from sitewhere_tpu.kernel.fastlane import (
     checkpoint_commit,
     fastlane_enabled,
 )
-from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
+from sitewhere_tpu.kernel.lifecycle import (
+    BackgroundTaskComponent,
+    LifecycleStatus,
+)
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.models.registry import build_model
 from sitewhere_tpu.scoring.settle import QUERY_POOL
@@ -182,6 +185,13 @@ class RuleProcessingEngine(TenantEngine):
                 self.add_child(shard)
         self.scored_sink = (self.egress if self.egress is not None
                             else self._deliver_scored)
+        # clean-handoff commit-through (docs/FLEET.md): lane loops
+        # cancelled by an engine stop stash their consumers here
+        # instead of closing them; _do_stop commits their delivered
+        # positions once the drain proves everything settled AND
+        # published — a clean release then hands off exactly-once
+        # (no replay of the last in-flight batch)
+        self._stopped_consumers: list = []
         self.hooks: dict[str, Hook] = {}
         # script manager: uploaded python scripts become hooks (reference:
         # Groovy stream processors synced per tenant, SURVEY.md §2.1)
@@ -252,6 +262,7 @@ class RuleProcessingEngine(TenantEngine):
         task = getattr(self, "_warmup_task", None)
         if task is not None and not task.done():
             task.cancel()
+        sink = self.session or self.pool_slot
         if self.session is not None:
             await self.session.drain(timeout=10.0)
             self.session.close()
@@ -266,6 +277,32 @@ class RuleProcessingEngine(TenantEngine):
             # their queues on the way down; this is the belt-and-braces
             # wait for anything a straggling settle enqueued after
             await self.egress.drain(timeout=5.0)
+        # commit-through: the lane loops died before their last
+        # checkpoint commit; with the drain complete (nothing pending,
+        # nothing unpublished) their HANDLED-through positions — the
+        # frontier of the last fully processed poll batch, never the
+        # raw delivered positions, which a cancellation mid-batch can
+        # leave past records nobody produced or admitted — are exactly
+        # the settled-and-published frontier. Committing them makes a
+        # clean handoff exactly-once instead of replaying the in-flight
+        # tail. A timed-out drain skips this (the unsettled tail must
+        # redeliver: at-least-once is the floor, never traded away).
+        idle = ((sink is None or getattr(sink, "idle", True))
+                and (self.egress is None or self.egress.idle))
+        if idle:
+            for consumer, handled in self._stopped_consumers:
+                if not handled:
+                    continue
+                try:
+                    consumer.commit(handled, fence=self.fence_token())
+                except FencedError:
+                    # zombie release: the new owner's offsets are the
+                    # truth now — commit nothing
+                    self.fence_lost()
+                    break
+        for consumer, _ in self._stopped_consumers:
+            consumer.close()
+        self._stopped_consumers.clear()
 
     async def shed_route(self, batch: MeasurementBatch, sink,
                          key: Optional[str] = None) -> None:
@@ -288,7 +325,7 @@ class RuleProcessingEngine(TenantEngine):
             t0 = time.monotonic()
             await self.runtime.bus.produce(
                 self.tenant_topic(TopicNaming.DEFERRED_EVENTS), batch,
-                key=key)
+                key=key, fence=self.fence_token())
             # the deferred off-ramp is part of the event's journey: a
             # sampled trace shows WHERE it left the scored path (and
             # "flow.replay" later shows it coming back)
@@ -312,7 +349,7 @@ class RuleProcessingEngine(TenantEngine):
         t0 = time.monotonic()
         await self.runtime.bus.produce(
             self.tenant_topic(TopicNaming.SCORED_EVENTS), scored,
-            key=scored.ctx.source)
+            key=scored.ctx.source, fence=self.fence_token())
         # same stage name as the fused EgressStage records: traces stay
         # comparable across the inline and fused egress configurations
         self.runtime.tracer.record(
@@ -484,6 +521,10 @@ class RuleProcessor(BackgroundTaskComponent):
         # egress stage (kernel/egresslane.py): offsets commit only once
         # settles have PUBLISHED, not merely settled
         barrier = commit_barrier(sink, engine.egress)
+        # handled-through frontier for the clean-handoff commit-through:
+        # a cancellation mid-batch must not let the stop path commit
+        # past records this loop never admitted
+        handled = None
         cap = getattr(getattr(session, "cfg", None), "backlog_events", 0)
         if not cap and engine.pool_slot is not None:
             cap = engine.pool_slot.pool.cfg.backlog_events
@@ -561,6 +602,8 @@ class RuleProcessor(BackgroundTaskComponent):
                             await hook(value, api)
                         except Exception:  # noqa: BLE001 - hook errors isolated
                             logger.exception("hook %s failed", name)
+                if records:
+                    handled = consumer.delivered_positions()
                 if sink is not None and sink.flush_due:
                     # pipelined: dispatch now; the settled batch reaches
                     # the scored sink (publish + alerts) without blocking
@@ -608,18 +651,36 @@ class RuleProcessor(BackgroundTaskComponent):
                         except Exception as exc:  # noqa: BLE001
                             await engine.dead_letter(rec, exc, self.path)
                     if replayed:
-                        deferred_consumer.commit()
+                        try:
+                            deferred_consumer.commit(
+                                fence=engine.fence_token())
+                        except FencedError:
+                            # this worker lost the tenant mid-replay:
+                            # report it (the fleet worker stops these
+                            # engines) and leave the spool offsets for
+                            # the new owner
+                            engine.fence_lost()
                 # at-least-once without commit starvation: when the sink
                 # is idle, commit directly; under steady pipelined load,
                 # the shared checkpoint barrier (kernel/fastlane.py —
                 # one implementation for both lanes) commits snapshots
                 # once everything dispatched before them has settled
                 # AND published. A crash redelivers the unsettled tail.
-                ckpt = await checkpoint_commit(consumer, barrier, ckpt)
+                ckpt = await checkpoint_commit(consumer, barrier, ckpt,
+                                               fence=engine.fence)
         finally:
             if deferred_consumer is not None:
                 deferred_consumer.close()
-            consumer.close()
+            if engine.status == LifecycleStatus.STOPPING:
+                # engine stop (release/handoff): hand the consumer +
+                # its handled-through positions to _do_stop for the
+                # post-drain commit-through; it closes it afterwards
+                engine._stopped_consumers.append((consumer, handled))
+            else:
+                # supervised restart: leave the group now — a fresh
+                # consumer joins on the next run, and a lingering dead
+                # member would starve its partitions
+                consumer.close()
 
 
 class RuleProcessingService(Service):

@@ -25,13 +25,19 @@ class StoreSnapshotter(BackgroundTaskComponent):
     def __init__(self, name: str, path: str,
                  epoch_fn: Callable[[], int],
                  collect_fn: Callable[[], dict],
-                 interval_s: float = 1.0):
+                 interval_s: float = 1.0,
+                 on_saved: Callable[[int], None] = None):
         super().__init__(name)
         self.snap_path = path
         self._epoch = epoch_fn
         self._collect = collect_fn
         self.interval_s = interval_s
         self._lock = threading.Lock()
+        # called (on the event loop / the save_now caller's thread) with
+        # the mutation epoch a just-written snapshot covers — the
+        # registry WAL resets itself here (persistence/durable.py
+        # WriteAheadLog: records ≤ a persisted snapshot are obsolete)
+        self._on_saved = on_saved
 
     def _write(self, snap: dict) -> None:
         with self._lock:
@@ -39,7 +45,10 @@ class StoreSnapshotter(BackgroundTaskComponent):
 
     def save_now(self) -> None:
         """Synchronous collect+write (clean-shutdown path)."""
+        epoch = self._epoch()
         self._write(self._collect())
+        if self._on_saved is not None:
+            self._on_saved(epoch)
 
     async def _run(self) -> None:
         saved_epoch = -1
@@ -52,3 +61,5 @@ class StoreSnapshotter(BackgroundTaskComponent):
             snap = self._collect()
             await loop.run_in_executor(None, self._write, snap)
             saved_epoch = epoch
+            if self._on_saved is not None:
+                self._on_saved(epoch)

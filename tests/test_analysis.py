@@ -18,6 +18,7 @@ from sitewhere_tpu.analysis.registry import (
 
 SVC = "sitewhere_tpu/services/somesvc.py"          # non-ingress module
 INGRESS = "sitewhere_tpu/services/event_sources.py"  # ingress module
+FENCED = "sitewhere_tpu/services/device_state.py"  # fleet-managed module
 
 
 def _codes(report):
@@ -279,6 +280,64 @@ def test_dlq01_suppressed_on_for_line():
     """)
     assert _codes(rep) == []
     assert len(rep.suppressed) == 1
+
+
+# -- FEN01 -------------------------------------------------------------------
+
+
+def test_fen01_unfenced_produce_in_fleet_module():
+    rep = _lint("""
+        class Loop:
+            async def run(self):
+                await self.bus.produce("topic", {})
+    """, path=FENCED)
+    assert _codes(rep) == ["FEN01"]
+    assert rep.findings[0].qualname == "Loop.run"
+
+
+def test_fen01_unfenced_commit_and_produce_nowait():
+    rep = _lint("""
+        class Loop:
+            async def run(self):
+                self.bus.produce_nowait("topic", {})
+                self.consumer.commit()
+    """, path=FENCED)
+    assert _codes(rep) == ["FEN01", "FEN01"]
+
+
+def test_fen01_negative_with_fence_kwarg():
+    rep = _lint("""
+        class Loop:
+            async def run(self):
+                await self.bus.produce("topic", {},
+                                       fence=self.engine.fence_token())
+                self.consumer.commit(fence=None)
+    """, path=FENCED)
+    assert _codes(rep) == []
+
+
+def test_fen01_scoped_to_fenced_modules():
+    rep = _lint("""
+        class Loop:
+            async def run(self):
+                await self.bus.produce("scored-events", {})
+    """, path=SVC)
+    assert _codes(rep) == []
+
+
+def test_fen01_suppressed_and_baselined():
+    src = """
+        class Loop:
+            async def run(self):
+                await self.bus.produce("topic", {})  # swxlint: disable=FEN01
+                self.consumer.commit()
+    """
+    rep = _lint(src, path=FENCED)
+    assert _codes(rep) == ["FEN01"] and len(rep.suppressed) == 1
+    baseline = Baseline(entries={
+        (FENCED, "FEN01", "Loop.run"): "documented control-plane path"})
+    rep = _lint(src, path=FENCED, baseline=baseline)
+    assert _codes(rep) == [] and len(rep.baselined) == 1
 
 
 # -- FLT01 -------------------------------------------------------------------

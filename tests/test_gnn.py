@@ -214,9 +214,18 @@ def test_e2e_maintenance_sweep_batch_operation(run):
                 ts=np.full(failed.shape[0], 2400.0), source="device"))
 
             ops = rt.api("batch-operations").operations("acme")
+            # De-flaked (the documented full-suite-only intermittent,
+            # known since PR 6): at lr=3e-2/steps=200 the weakest
+            # unlabeled sibling's risk sat AT the 0.5 threshold, and the
+            # chaotic training trajectory amplified XLA-CPU reduction-
+            # order noise (thread-load dependent) across the boundary.
+            # lr=1e-2/steps=300/threshold=0.3 was chosen by a
+            # perturbation probe (±1e-4 feature noise, 16 trials):
+            # sibling risk min 0.544, healthy-asset max 0.001 — margins
+            # on BOTH sides of the threshold instead of a knife edge.
             op = await ops.submit_maintenance_operation(
-                hidden=16, layers=2, max_degree=8, steps=200,
-                learning_rate=3e-2, window=32, risk_threshold=0.5,
+                hidden=16, layers=2, max_degree=8, steps=300,
+                learning_rate=1e-2, window=32, risk_threshold=0.3,
                 feature_dropout=0.5)
             done = await ops.wait_for_operation(op.id, timeout=120.0)
             result = done.parameters["result"]
@@ -240,7 +249,7 @@ def test_e2e_maintenance_sweep_batch_operation(run):
             # must NOT become training labels (self-reinforcement loop)
             op2 = await ops.submit_maintenance_operation(
                 hidden=16, layers=2, max_degree=8, steps=50,
-                learning_rate=3e-2, window=32, risk_threshold=0.5,
+                learning_rate=1e-2, window=32, risk_threshold=0.3,
                 feature_dropout=0.5)
             done2 = await ops.wait_for_operation(op2.id, timeout=120.0)
             assert done2.parameters["result"]["labeled_failures"] == 5

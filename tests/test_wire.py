@@ -163,6 +163,71 @@ def test_wire_commit_pins_delivered_positions(run):
     run(main())
 
 
+def test_wire_fencing_produce_and_commit(run):
+    """Epoch fencing over the wire (docs/FLEET.md): a stale-epoch
+    produce raises the DISTINCT FencedError client-side (typed, with
+    the tenant attached — the worker's 'stop engines, do not retry'
+    signal), and a fire-and-forget stale commit both leaves the group
+    offsets untouched broker-side AND surfaces through the client's
+    on_fenced callback."""
+    from sitewhere_tpu.kernel.bus import FencedError
+
+    async def main():
+        bus = EventBus(default_partitions=1)
+        server = BusServer(bus)
+        await server.start()
+        remote = RemoteEventBus("127.0.0.1", server.port)
+        await remote.initialize()
+        fenced_tenants = []
+        # the callback receives (tenant, rejected-token epoch) so the
+        # worker can ignore stale rejections of superseded grants
+        remote.on_fenced = lambda tenant, epoch: fenced_tenants.append(
+            (tenant, epoch))
+
+        ctl = "wx.instance.fleet-control"
+        topic = "wx.tenant.t0.inbound-events"
+        # epoch 1 places t0 on w0; epoch 2 moves it to w1 with w0 DEAD
+        # (absent from the live list) — w0's writes must reject NOW
+        await remote.produce(ctl, {"kind": "placement", "epoch": 1,
+                                   "assignment": {"t0": "w0"},
+                                   "workers": ["w0", "w1"]})
+        await remote.produce(topic, {"n": 1}, fence=["t0", 1, "w0"])
+        await remote.produce(ctl, {"kind": "placement", "epoch": 2,
+                                   "assignment": {"t0": "w1"},
+                                   "workers": ["w1"]})
+        try:
+            await remote.produce(topic, {"n": 2}, fence=["t0", 1, "w0"])
+            raise AssertionError("stale-epoch produce was accepted")
+        except FencedError as exc:
+            assert exc.tenant == "t0"
+        # the new owner writes fine
+        await remote.produce(topic, {"n": 3}, fence=["t0", 2, "w1"])
+
+        # stale fire-and-forget commit: rejected broker-side, reported
+        # through on_fenced (no caller awaits the RPC)
+        consumer = remote.subscribe(topic, group="t0.inbound-processing")
+        records = await consumer.poll(max_records=10, timeout=2.0)
+        assert len(records) == 2
+        consumer.commit(fence=["t0", 1, "w0"])
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while not fenced_tenants \
+                and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+        assert fenced_tenants == [("t0", 1)]
+        assert not bus._groups["t0.inbound-processing"].committed, (
+            "a fenced commit moved group offsets")
+        # the owner's commit lands
+        consumer.commit(fence=["t0", 2, "w1"])
+        await asyncio.sleep(0.2)
+        assert bus._groups["t0.inbound-processing"].committed
+        assert bus.fences.rejections >= 2
+        consumer.close()
+        await remote.stop()
+        await server.stop()
+
+    run(main())
+
+
 def test_api_channel_engine_calls(run):
     """Control plane: a peer resolves an engine and calls its methods
     (numpy in/out) over the wire, with wait-for-engine semantics."""
