@@ -1566,9 +1566,17 @@ async def run_bench(args) -> dict:
                         if args.no_fastlane else {})
     # --no-egress-fusion / --egress-lanes: the egress A/B + sharding
     # levers (kernel/egresslane.py) — fused publish off the flush path,
-    # N consumer loops per group (lanes ≤ bus partitions are useful)
+    # N consumer loops per group (lanes ≤ bus partitions are useful);
+    # --egress-autotune floats the ACTIVE egress lane count on
+    # TelemetryBeat signals (decisions counted in the artifact)
     egress_section = {"egress": {"fused": not args.no_egress_fusion,
-                                 "lanes": max(args.egress_lanes, 1)}}
+                                 "lanes": max(args.egress_lanes, 1),
+                                 "autotune": bool(args.egress_autotune)}}
+    # --mesh DxM: the serving-mesh lever — the shared pool shards its
+    # stacked dispatch (tenant rows → `model`, batch columns → `data`);
+    # mesh_from_spec fits the spec to this process's actual devices
+    mesh_section = ({"mesh": dict(args.mesh_spec)} if args.mesh_spec
+                    else {})
     # ONE fleet-size bucket: throughput is inflight × bucket / RTT on the
     # tunneled chip (bigger flushes win) and every extra bucket is another
     # warmup compile. (A CPU bucket ladder was tried for the latency
@@ -1594,6 +1602,7 @@ async def run_bench(args) -> dict:
                 # dispatch lever (scoring/pool.py) — ONE jit call per
                 # flush round for every tenant vs one per tenant
                 "megabatch": {"enabled": args.megabatch},
+                **mesh_section,
             },
         }))
     sims, receivers, sinks = [], [], []
@@ -1621,9 +1630,17 @@ async def run_bench(args) -> dict:
     engines = [rt.api("rule-processing").engine(tid) for tid in tenant_ids]
     megabatch_on = all(e.megabatch and e.pool_slot is not None
                        for e in engines)
-    eff_window_ms = (engines[0].pool_slot.pool.cfg.window_s * 1e3
-                     if engines[0].pool_slot is not None
+    pool0 = (engines[0].pool_slot.pool
+             if engines[0].pool_slot is not None else None)
+    eff_window_ms = (pool0.cfg.window_s * 1e3 if pool0 is not None
                      else args.window_ms)
+    # mesh provenance from the LIVE pool (mesh_from_spec may have
+    # fitted the request down to this process's devices — the artifact
+    # records what actually ran, not what was asked for)
+    mesh_devices = (pool0.mesh.size
+                    if pool0 is not None and pool0.mesh is not None else 0)
+    mesh_shape = (dict(pool0.mesh.shape)
+                  if pool0 is not None and pool0.mesh is not None else None)
     # instance-wide flush-path jit dispatch counter (sessions AND pools
     # inc the same registry counter): per-trial deltas make the
     # dispatch-rate collapse measurable in the artifact
@@ -1846,6 +1863,11 @@ async def run_bench(args) -> dict:
             "critical_path": cp["stages"],
         }
 
+    # final auto-tuner state, captured BEFORE stop tears the engines
+    # down (the engine registry empties at rt.stop)
+    egress_active = (max(e.egress.active for e in engines)
+                     if egress_on else 0)
+
     chaos = None
     if fi is not None:
         restarts = rt.metrics.counter("supervisor.restarts").value
@@ -1886,7 +1908,13 @@ async def run_bench(args) -> dict:
         # egress provenance: fused = scored publishes + alert emission
         # ride supervised shard loops off the flush path
         # (kernel/egresslane.py); lanes = consumer loops per group
-        "egress": {"fused": egress_on, "lanes": egress_lanes_live},
+        "egress": {"fused": egress_on, "lanes": egress_lanes_live,
+                   # lane auto-tuner provenance: final active lane
+                   # count + decisions taken (0/absent = tuner off)
+                   "autotune": bool(args.egress_autotune),
+                   "active_lanes": egress_active,
+                   "autotune_adjusts": int(rt.metrics.counter(
+                       "egress.autotune_adjusts").value)},
         # megabatch provenance + the dispatch-rate collapse (the A/B's
         # acceptance number): dispatches/dispatch_rate are the best
         # saturation trial's flush-path jit dispatch count/rate —
@@ -1894,7 +1922,19 @@ async def run_bench(args) -> dict:
         # compare directly
         "scoring": {
             "megabatch": megabatch_on,
+            # serving mesh: requested spec + what actually ran (0
+            # devices = single-device stacked dispatch)
+            "mesh": {"spec": args.mesh_spec, "shape": mesh_shape,
+                     "devices": mesh_devices},
             "window_ms": round(eff_window_ms, 3),
+            # adaptive-window state: the LIVE close deadline the tuner
+            # converged on + how many times it moved (auto-tuner
+            # decision count, the A/B's self-tuning evidence)
+            "window_ms_live": (round(pool0._window_s * 1e3, 3)
+                               if pool0 is not None
+                               else round(eff_window_ms, 3)),
+            "window_adjusts": int(rt.metrics.counter(
+                "scoring.megabatch_window_adjusts").value),
             "dispatches": best["dispatches"],
             "dispatch_rate": best["dispatch_rate"],
             "events_per_dispatch": (round(scored / best["dispatches"], 1)
@@ -1922,6 +1962,12 @@ async def run_bench(args) -> dict:
         "model_flops_per_event": flops_ev,
         "model_tflops": round(model_flops_s / 1e12, 3),
         "model_tflops_median": round(model_tflops_median, 4),
+        # the mesh acceptance metric: achieved model TFLOP/s divided
+        # over the devices the dispatch actually spans — on real
+        # multi-chip hardware this is the per-chip utilization the
+        # sharding exists to move off the floor
+        "model_tflops_per_device": round(
+            model_tflops_median / max(mesh_devices or n_chips, 1), 5),
         "mfu": round(mfu, 5) if mfu is not None else None,
         "fleet_devices": args.devices,
         # EFFECTIVE mode, not the flag: window-ring models fall back to
@@ -1995,6 +2041,19 @@ def main() -> None:
                         help="pin dedicated per-tenant sessions (one jit "
                              "dispatch per tenant per flush round) — the "
                              "megabatch A/B lever")
+    parser.add_argument("--mesh", default=None, metavar="DxM",
+                        help="shard the megabatch dispatch over a "
+                             "{data: D, model: M} device mesh "
+                             "(parallel/mesh.py axis convention: tenant "
+                             "rows on `model`, batch columns on `data`). "
+                             "On CPU rigs the harness forces D×M "
+                             "host-platform devices via XLA_FLAGS so the "
+                             "sharding is real, not simulated")
+    parser.add_argument("--egress-autotune", action="store_true",
+                        help="enable the egress lane-count auto-tuner "
+                             "(kernel/egresslane.py): active lanes float "
+                             "in [1, max] on TelemetryBeat signals; "
+                             "decisions are counted in the artifact")
     parser.add_argument("--max-inflight", type=int, default=8,
                         help="dispatched-not-settled flush bound; small "
                              "values cap XLA queue depth (tail latency), "
@@ -2125,6 +2184,42 @@ def main() -> None:
         # must land before ANY jax import: the image re-asserts
         # JAX_PLATFORMS=axon at interpreter startup (see tests/conftest.py)
         os.environ["JAX_PLATFORMS"] = "cpu"
+    args.mesh_spec = None
+    if args.mesh:
+        try:
+            d, _, m = args.mesh.lower().partition("x")
+            args.mesh_spec = {"data": int(d), "model": int(m or 1)}
+        except ValueError:
+            parser.error(f"--mesh wants DxM (e.g. 4x2), got {args.mesh!r}")
+        if args.mesh_spec["data"] < 1 or args.mesh_spec["model"] < 1:
+            parser.error(f"--mesh axes must be positive, got {args.mesh!r}")
+        if not args.megabatch:
+            parser.error("--mesh shards the megabatch pool's stacked "
+                         "dispatch; drop --no-megabatch")
+        if args.workers > 0:
+            # the fleet bench builds its own worker tenant config and
+            # does not thread the mesh through it (yet): refuse loudly
+            # rather than force D×M host devices on every worker while
+            # nothing actually shards
+            parser.error("--mesh is not threaded into the fleet bench's "
+                         "worker config; run it without --workers")
+        want = args.mesh_spec["data"] * args.mesh_spec["model"]
+        flags = os.environ.get("XLA_FLAGS", "")
+        if (os.environ.get("JAX_PLATFORMS") or "cpu") == "cpu" \
+                and "xla_force_host_platform_device_count" not in flags:
+            # like --force-cpu, this must land before ANY jax import: a
+            # CPU rig then exercises a REAL D×M host-platform device
+            # mesh (collectives and all), not a silently-fitted no-op.
+            # Unset JAX_PLATFORMS counts as cpu: the flag only shapes
+            # the HOST platform, so an accelerator rig that auto-selects
+            # tpu is unaffected, while a plain CPU host without
+            # --force-cpu no longer runs a silently-meshless "on" leg
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
+    if args.egress_autotune and args.workers > 0:
+        parser.error("--egress-autotune is not threaded into the fleet "
+                     "bench's worker config; run it without --workers")
     if args.probe_only:
         # fresh-process probe body: single in-process attempt (this process
         # IS the isolation), result as a JSON line for the supervisor

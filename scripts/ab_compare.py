@@ -28,6 +28,12 @@ Presets (the levers bench.py exposes):
               worker-kill drill), b = `--workers 1` — the scale-out
               A/B; the table compares aggregate scored-events/s and
               the kill drill's zero-loss accounting
+    mesh      on = `--mesh DxM --egress-autotune` (serving mesh over
+              forced host-platform devices on CPU rigs: tenant rows
+              on `model`, batch columns on `data`, self-tuning
+              window/lanes), off = the same megabatched tenants
+              meshless — the mesh-serving A/B (per-device tflops +
+              auto-tuner decision counts in the table)
 
 Usage:
 
@@ -169,6 +175,29 @@ def delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
                      f"{sc_b.get('tenants_per_dispatch_p50')}",
                      f"{sc_a.get('megabatch')} / "
                      f"{sc_a.get('tenants_per_dispatch_p50')}", ""))
+        mesh_a = sc_a.get("mesh") or {}
+        mesh_b = sc_b.get("mesh") or {}
+        if mesh_a.get("devices") or mesh_b.get("devices"):
+            rows.append(("mesh devices / window live ms / adjusts",
+                         f"{mesh_b.get('devices', 0)} / "
+                         f"{sc_b.get('window_ms_live', '—')} / "
+                         f"{sc_b.get('window_adjusts', 0)}",
+                         f"{mesh_a.get('devices', 0)} / "
+                         f"{sc_a.get('window_ms_live', '—')} / "
+                         f"{sc_a.get('window_adjusts', 0)}", ""))
+            rows.append(("tflops per device (median)",
+                         f"{b.get('model_tflops_per_device', 0)}",
+                         f"{a.get('model_tflops_per_device', 0)}",
+                         ratio(a.get("model_tflops_per_device", 0.0) or 0.0,
+                               b.get("model_tflops_per_device", 0.0)
+                               or 0.0)))
+        eg2_a, eg2_b = a.get("egress", {}), b.get("egress", {})
+        if eg2_a.get("autotune") or eg2_b.get("autotune"):
+            rows.append(("egress autotune: active lanes / adjusts",
+                         f"{eg2_b.get('active_lanes', '—')} / "
+                         f"{eg2_b.get('autotune_adjusts', 0)}",
+                         f"{eg2_a.get('active_lanes', '—')} / "
+                         f"{eg2_a.get('autotune_adjusts', 0)}", ""))
     rows.append(("model_tflops (best / median)",
                  f"{b.get('model_tflops', 0)} / "
                  f"{b.get('model_tflops_median', 0)}",
@@ -187,7 +216,18 @@ def main() -> int:
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
                                            "megabatch", "observe",
-                                           "fleet"])
+                                           "fleet", "mesh"])
+    parser.add_argument("--mesh-shape", default="1x8",
+                        help="DxM mesh for the mesh preset's on leg "
+                             "(forced host-platform devices on CPU "
+                             "rigs); the off leg runs the same tenants "
+                             "meshless. Default is model-axis-heavy: "
+                             "tenant shards own their state outright, "
+                             "while data-axis width replicates ring "
+                             "state across its devices — measured "
+                             "{1x8: 8.1, 2x4: 10.0, 4x2: 18.7, 8x1: "
+                             "30.9} ms/dispatch on the 8-vdev CPU rig "
+                             "(docs/PERFORMANCE.md axis guidance)")
     parser.add_argument("--workers", type=int, default=2,
                         help="worker-process count for the fleet "
                              "preset's scale-out leg (the other leg "
@@ -223,6 +263,17 @@ def main() -> int:
                  ("on", ["--tenants", t])]
         names = (f"megabatch off ({t} tenants)",
                  f"megabatch on ({t} tenants)")
+    elif args.preset == "mesh":
+        # both legs megabatch the same tenants; the variable is the
+        # serving mesh (tenant rows → model axis, batch → data axis) +
+        # the self-tuning dispatch it ships with. On CPU the on leg
+        # forces DxM host-platform devices so the sharding is real.
+        t = str(args.tenants)
+        pairs = [("off", ["--tenants", t]),
+                 ("on", ["--tenants", t, "--mesh", args.mesh_shape,
+                         "--egress-autotune"])]
+        names = (f"mesh off ({t} tenants)",
+                 f"mesh {args.mesh_shape} ({t} tenants)")
     elif args.preset == "observe":
         pairs = [("off", ["--no-observe"]), ("on", [])]
         names = ("observe off", "observe on")

@@ -19,4 +19,41 @@ python -m compileall -q sitewhere_tpu || exit 1
 # JSON report is the CI artifact (exit 1 = new findings, see output)
 python -m sitewhere_tpu.analysis --format json || { echo "swxlint: new findings (see JSON above; docs/ANALYSIS.md)"; exit 1; }
 
+# forced-multi-device smoke (docs/PERFORMANCE.md mesh serving): a REAL
+# 8-device {data: 4, model: 2} host-platform mesh must shard the
+# stacked dispatch and survive a donated hot-swap — sharding
+# regressions fail here in tier-1, not only on TPU rigs. (The pytest
+# sweep below runs under the same 8-virtual-device conftest; this
+# smoke keeps the contract visible even if conftest ever changes.)
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PY' || { echo "mesh smoke: FAILED (sharded stacked dispatch broken)"; exit 1; }
+import jax, numpy as np
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, jax.devices()
+from sitewhere_tpu.models import build_model
+from sitewhere_tpu.parallel.mesh import mesh_from_spec
+from sitewhere_tpu.parallel.tenant_stack import TenantStack
+from sitewhere_tpu.scoring.ring import StackedDeviceRing
+
+mesh = mesh_from_spec({"data": 4, "model": 2})
+assert dict(mesh.shape) == {"data": 4, "model": 2}
+model = build_model("zscore", window=8)
+stack = TenantStack(model, mesh=mesh)
+for tid in ("a", "b", "c"):
+    stack.add_tenant(tid)
+ring = StackedDeviceRing(8, stack.capacity, device_cap=32, mesh=mesh)
+b = stack.pad_batch(16)
+dev = np.full((stack.capacity, b), ring.device_cap, np.int32)
+val = np.zeros((stack.capacity, b), np.float32)
+dev[0, :4] = np.arange(4); val[0, :4] = 21.0
+scores = ring.update_and_score(model, stack.stacked, dev, val)
+assert scores.shape == (stack.capacity, b), scores.shape
+assert len(scores.sharding.device_set) == 8, scores.sharding
+stack.set_params("b", model.init(jax.random.PRNGKey(1)))  # donated swap
+assert stack.versions["b"] == 1
+# model-axis placement survives growth + swap: ring state spans the mesh
+assert len(ring.values.sharding.device_set) == 8, ring.values.sharding
+np.asarray(ring.update_and_score(model, stack.stacked, dev, val))
+print("mesh smoke: OK (8-device {data:4, model:2} stacked dispatch)")
+PY
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -36,6 +36,7 @@ FAULT_SITES = frozenset({
     "durable.flush",      # persistence/durable.py spill writer
     "scoring.dispatch",   # scoring/server.py flush paths
     "scoring.megabatch",  # scoring/pool.py megabatch admission
+    "scoring.mesh",       # scoring/pool.py mesh-sharded dispatch admission
     "flow.admit",         # kernel/flow.py ingress admission
     "flow.shed",          # kernel/flow.py shed-mode consult
     "observe.beat",       # kernel/observe.py telemetry-beat sampler tick
@@ -136,6 +137,12 @@ COUNTERS = (
     "fence.rejections",   # stale-epoch data-path writes rejected
     "fence.replays",      # journal records replayed on adoption
     "fence.wal_appends",  # registry WAL appends (crash-bound tightener)
+    # broker-side member eviction on death declarations (kernel/bus.py)
+    "fleet.members_evicted",
+    # self-tuning dispatch (mesh serving, docs/PERFORMANCE.md):
+    # adaptive-megabatch-window and egress-lane tuner decisions
+    "scoring.megabatch_window_adjusts",
+    "egress.autotune_adjusts",
 )
 
 GAUGES = (
@@ -151,6 +158,12 @@ GAUGES = (
     "fleet.workers_live",
     "fleet.placement_epoch",
     "fleet.tenants_pending",
+    # mesh-sharded serving + self-tuning dispatch (scoring/pool.py,
+    # kernel/egresslane.py): devices under the stacked dispatch, the
+    # live adaptive megabatch window, active egress lanes
+    "scoring.mesh_devices",
+    "scoring.megabatch_window_ms",
+    "egress.autotune_lanes",
 )
 
 METERS = (

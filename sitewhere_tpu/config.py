@@ -62,6 +62,25 @@ class InstanceSettings:
     scoring_megabatch: bool = False
     scoring_megabatch_window_ms: float = 1.0
     scoring_megabatch_max_tenants: int = 0
+    # adaptive megabatch window (scoring/pool.py `_WindowTuner`): the
+    # live close deadline floats in [window_ms, 8×window_ms], keyed to
+    # the active-tenant count vs the observed tenants-per-dispatch
+    # occupancy — sparse fleets earn a wider aggregation window, dense
+    # ones converge back to the configured floor. Hysteresis + cooldown
+    # keep it from flapping (test-pinned). Tenant
+    # `megabatch: {autotune}` overrides.
+    scoring_megabatch_autotune: bool = True
+    # mesh-sharded megabatch serving (parallel/mesh.py axis convention):
+    # shard the shared pool's stacked dispatch over a {data, model}
+    # device mesh — tenant rows (params, rings) on the `model` axis,
+    # batch columns on the `data` axis, XLA inserting the collectives.
+    # 0/0 = no mesh (single-device stacked dispatch, the CPU/1-chip
+    # operating point). The spec degrades gracefully when the process
+    # has fewer devices (parallel/mesh.mesh_from_spec), so ONE config
+    # serves the 1-core CI rig and a TPU pod. Tenant
+    # `rule-processing: {mesh: {data, model}}` overrides.
+    scoring_mesh_data: int = 0
+    scoring_mesh_model: int = 0
     # engine spin-up bound: first TPU compiles over a tunneled chip can
     # take minutes — the old 60 s default killed whole bench runs
     engine_ready_timeout_s: float = 300.0
@@ -110,6 +129,19 @@ class InstanceSettings:
     # splitting partitions. Tenant `egress: {fused, lanes}` overrides.
     egress_fused: bool = True
     egress_lanes: int = 1
+    # egress lane-count auto-tuner (kernel/egresslane.py): the stage
+    # watches the TelemetryBeat's signals — its own backlog, event-loop
+    # lag, the tenant's overload mode — and floats the ACTIVE shard
+    # count in [1, egress_autotune_max_lanes]: sustained backlog earns
+    # another lane, sustained loop lag (the measured 1-core trade:
+    # extra lanes deepen the XLA dispatch queue) sheds one. Lane
+    # switches apply only while the stage is idle (per-key publish
+    # order holds by construction) and carry hysteresis + cooldown
+    # (test-pinned). Off by default — `egress: {autotune: true}` (or
+    # the bench's `--egress-autotune`) opts in; `egress_lanes` stays
+    # the static default and the tuner's starting point.
+    egress_autotune: bool = False
+    egress_autotune_max_lanes: int = 4
     # fleet control plane (sitewhere_tpu/fleet): `fleet_managed: true`
     # marks a WORKER runtime whose tenant engines are driven by fleet
     # placement records — the TenantEngineManager stands down (it must

@@ -57,6 +57,10 @@ def build_runtime(cfg: dict):
         **(cfg.get("settings") or {}))
     bus = RemoteEventBus(cfg.get("host", "127.0.0.1"), cfg["port"],
                          secret=cfg.get("secret"))
+    # owner-tag every membership this worker registers: a controller
+    # death declaration then evicts them broker-side, so a SIGSTOPped
+    # zombie's partitions reassign instead of stalling until SIGCONT
+    bus.owner = cfg["worker_id"]
     rt = ServiceRuntime(settings, bus=bus)
     for cls in (DeviceManagementService, InboundProcessingService,
                 EventManagementService, DeviceStateService,

@@ -271,6 +271,14 @@ class EventBus(LifecycleComponent):
         # fleet-control placement record to flow through this broker;
         # None on non-fleet buses — the hot path pays one suffix test
         self.fences: Optional[FenceAuthority] = None
+        # broker-side member eviction (docs/FLEET.md): the live-worker
+        # set of the last placement record. A worker DROPPED from it
+        # (declared dead, or left) has its owner-tagged consumer-group
+        # members evicted, so a SIGSTOPped zombie's memberships stop
+        # stalling their partitions until SIGCONT — the session-timeout
+        # analog the in-proc bus never had. None until the first
+        # placement flows through.
+        self._fleet_live: Optional[set[str]] = None
         # optional metrics registry (set by the runtime that OWNS this
         # bus) so fenced rejections surface as `fence.rejections`
         self.metrics = None
@@ -357,6 +365,50 @@ class EventBus(LifecycleComponent):
             if self.fences is None:
                 self.fences = FenceAuthority()
             self.fences.observe(value)
+        if kind == "placement":
+            live = set(value.get("workers") or ())
+            if self._fleet_live is not None:
+                # the controller's death declaration IS the drop from
+                # the live list (a graceful leave closed its own
+                # consumers already — eviction is then a no-op)
+                for wid in sorted(self._fleet_live - live):
+                    self.evict_owner(wid)
+            self._fleet_live = live
+
+    def evict_owner(self, owner: str) -> int:
+        """Evict every consumer-group member a worker registered
+        (`subscribe(owner=...)`): the member leaves its group — its
+        partitions reassign to surviving members NOW — and any late
+        commit from it is refused. The fence authority already rejects
+        a zombie's tenant-scoped writes; this closes the remaining
+        stall: a silent member holds its partition assignment forever
+        on a bus with no session timeout, so the NEW owner of a moved
+        tenant would share (and wait on) partitions a SIGSTOPped
+        process can never drain."""
+        evicted = 0
+        for state in self._groups.values():
+            for member in [m for m in state.members if m.owner == owner]:
+                if all(t.endswith(_FLEET_CONTROL_SUFFIX)
+                       for t in member._topics):
+                    # NEVER evict a worker's fleet-control subscription:
+                    # each worker consumes the control topic under its
+                    # own group (broadcast semantics — no partition
+                    # contention to relieve), and a falsely-declared
+                    # worker that resumes must still SEE placement
+                    # records, or it would heartbeat as live while
+                    # permanently deaf to every epoch after its death
+                    # declaration
+                    continue
+                member.evicted = True
+                member.close()
+                evicted += 1
+        if evicted:
+            logger.warning(
+                "bus: evicted %d consumer-group member(s) of dead worker "
+                "%s; their partitions reassign now", evicted, owner)
+            if self.metrics is not None:
+                self.metrics.counter("fleet.members_evicted").inc(evicted)
+        return evicted
 
     # -- produce -----------------------------------------------------------
 
@@ -418,14 +470,20 @@ class EventBus(LifecycleComponent):
     # -- consume -----------------------------------------------------------
 
     def subscribe(self, topics: Iterable[str] | str, *, group: str,
-                  name: Optional[str] = None) -> "BusConsumer":
+                  name: Optional[str] = None,
+                  owner: Optional[str] = None) -> "BusConsumer":
+        """`owner` tags the member with the fleet worker that holds it
+        (threaded through the wire subscribe by worker processes), so a
+        controller death declaration can evict the dead worker's
+        memberships broker-side (`evict_owner`)."""
         if isinstance(topics, str):
             topics = [topics]
         for t in topics:
             self.create_topic(t)
         state = self._groups.setdefault(group, _GroupState())
         consumer = BusConsumer(self, group, list(topics),
-                               name or f"{group}-{len(state.members)}")
+                               name or f"{group}-{len(state.members)}",
+                               owner=owner)
         state.members.append(consumer)
         state.rebalance(self)
         return consumer
@@ -452,10 +510,13 @@ class BusConsumer:
     member resumes from last commit (at-least-once).
     """
 
-    def __init__(self, bus: EventBus, group: str, topics: list[str], name: str):
+    def __init__(self, bus: EventBus, group: str, topics: list[str],
+                 name: str, owner: Optional[str] = None):
         self._bus = bus
         self.group = group
         self.name = name
+        self.owner = owner      # fleet worker holding this membership
+        self.evicted = False    # closed broker-side on a death declaration
         self._topics = topics
         self._assignment: list[tuple[str, int]] = []
         self._positions: dict[tuple[str, int], int] = {}
@@ -496,6 +557,12 @@ class BusConsumer:
 
     def poll_nowait(self, max_records: int = 512) -> list[TopicRecord]:
         """Drain available records without waiting."""
+        if self._closed:
+            # an evicted/closed member keeps its stale assignment list
+            # (rebalance only rewrites live members); reading through it
+            # would let a zombie re-consume partitions the group already
+            # reassigned
+            return []
         if self._bus.faults is not None:
             # chaos site: a fault here crashes the consuming service
             # loop BEFORE any position advances — the supervisor
@@ -585,7 +652,19 @@ class BusConsumer:
         data-path fencing token (see `EventBus.produce`): a stale-epoch
         commit raises FencedError and advances NOTHING — a zombie owner
         can never move a tenant group's offsets."""
+        # fence FIRST: a stale-epoch commit on a fenced tenant group
+        # must keep raising the TYPED FencedError (it travels the wire
+        # and fires on_fenced — the worker's ownership-loss signal);
+        # the eviction refusal below covers the unfenced remainder
         self._bus.check_fence(fence)
+        if self.evicted:
+            # a death-declared worker's membership: its offsets are the
+            # group's (and possibly a new owner's) truth now — a late
+            # commit from the zombie must not move them, even where no
+            # fence token rides the call
+            raise RuntimeError(
+                f"consumer {self.name} was evicted from group "
+                f"{self.group} (owner declared dead); commit refused")
         state = self._bus._groups[self.group]
         src = positions if positions is not None else self._positions
         for tp, pos in src.items():

@@ -144,6 +144,29 @@ def egress_lanes(tenant, runtime) -> int:
         return 1
 
 
+def egress_autotune(tenant, runtime) -> bool:
+    """Is the egress lane-count auto-tuner on for this tenant (tenant
+    `egress.autotune` over `InstanceSettings.egress_autotune`)? Pure
+    function of config, like the other lane predicates."""
+    section = tenant.section("egress")
+    if "autotune" in section:
+        return bool(section["autotune"])
+    return bool(getattr(runtime.settings, "egress_autotune", False))
+
+
+def egress_max_lanes(tenant, runtime) -> int:
+    """The auto-tuner's lane ceiling (tenant `egress.max_lanes` over
+    the instance default; never below the configured static lanes)."""
+    section = tenant.section("egress")
+    cap = section.get("max_lanes",
+                      getattr(runtime.settings, "egress_autotune_max_lanes",
+                              4))
+    try:
+        return max(int(cap), egress_lanes(tenant, runtime))
+    except (TypeError, ValueError):
+        return egress_lanes(tenant, runtime)
+
+
 class EgressStage:
     """Per-tenant fused egress: the scoring sink that never suspends.
 
@@ -157,7 +180,8 @@ class EgressStage:
 
     owns_sink_stage = True
 
-    def __init__(self, engine, lanes: int = 1):
+    def __init__(self, engine, lanes: int = 1, autotune: bool = False,
+                 max_lanes: Optional[int] = None):
         self.engine = engine
         self.scored_topic = engine.tenant_topic(TopicNaming.SCORED_EVENTS)
         self.tracer = engine.runtime.tracer
@@ -188,11 +212,97 @@ class EgressStage:
         # submitted == accounted
         self.submitted = 0
         self.accounted = 0
-        self.shards = [EgressShard(self, i) for i in range(max(lanes, 1))]
+        # lane auto-tune (the self-tuning half of mesh serving): shards
+        # are built to the CEILING up front — lifecycle children can't
+        # be added under load — and `active` bounds how many submit
+        # routes to. Idle shards cost one parked loop each. The tuner
+        # (autotune_observe, fed by the TelemetryBeat every beat) moves
+        # `active` one lane at a time on sustained signals: backlog per
+        # active lane past half the shard cap earns a lane, event-loop
+        # lag past the stall threshold while the lanes sit near-empty
+        # sheds one (the measured 1-core trade: extra lanes deepen the
+        # XLA dispatch queue — docs/PERFORMANCE.md). A switch APPLIES
+        # only while the stage is idle, so re-keying can never overtake
+        # a shard's backlog and break per-key publish order.
+        n = max(lanes, 1)
+        ceiling = max(max_lanes or n, n) if autotune else n
+        self.shards = [EgressShard(self, i) for i in range(ceiling)]
+        self.active = n
+        self._autotune = bool(autotune)
+        self._pending_active: Optional[int] = None
+        self._up_beats = 0
+        self._down_beats = 0
+        self._last_adjust_t = -1e9
+        self.autotune_adjusts = metrics.counter("egress.autotune_adjusts")
+        # per-tenant suffix (the registry's `:{suffix}` convention):
+        # one stage per tenant writes this gauge, and a shared base
+        # name would be last-writer-wins noise with >1 tenant
+        self.autotune_gauge = metrics.gauge(
+            f"egress.autotune_lanes:{engine.tenant_id}")
+        self.autotune_gauge.set(self.active)
 
     @property
     def lanes(self) -> int:
         return len(self.shards)
+
+    # the tuner's thresholds: N consecutive beats of one signal (a
+    # single spike never moves a lane) + a wall-clock cooldown between
+    # adjustments; up and down trigger on DISJOINT conditions (high
+    # backlog vs lag-with-idle-lanes), so the tuner converges instead
+    # of oscillating (test-pinned)
+    AUTOTUNE_CONSECUTIVE = 4
+    AUTOTUNE_COOLDOWN_S = 5.0
+
+    def autotune_observe(self, loop_lag_s: float, stall_s: float,
+                         mode: str = "ok") -> None:
+        """One TelemetryBeat observation (kernel/observe.py calls this
+        every beat): fold the beat's signals — this stage's backlog,
+        the event loop's lag, the tenant's overload mode — into the
+        lane tuner."""
+        if not self._autotune:
+            return
+        self._apply_pending()
+        per_lane = self.backlog / max(self.active, 1)
+        want_up = (per_lane > self.MAX_BACKLOG_PER_SHARD / 2
+                   and self.active < len(self.shards))
+        # lanes that are not earning their keep: the loop is lagging
+        # (or the tenant is shedding) while the shard queues sit
+        # near-empty — publish parallelism is not the bottleneck, the
+        # extra loops are just dispatch-queue depth
+        want_down = (self.active > 1
+                     and per_lane < self.MAX_BACKLOG_PER_SHARD / 4
+                     and (loop_lag_s >= stall_s or mode != "ok"))
+        self._up_beats = self._up_beats + 1 if want_up else 0
+        self._down_beats = self._down_beats + 1 if want_down else 0
+        now = time.monotonic()
+        if now - self._last_adjust_t < self.AUTOTUNE_COOLDOWN_S:
+            return
+        if self._up_beats >= self.AUTOTUNE_CONSECUTIVE:
+            self._pending_active = self.active + 1
+        elif self._down_beats >= self.AUTOTUNE_CONSECUTIVE:
+            self._pending_active = self.active - 1
+        else:
+            return
+        self._up_beats = self._down_beats = 0
+        self._last_adjust_t = now
+        self._apply_pending()
+
+    def _apply_pending(self) -> None:
+        """Apply a decided lane switch, but ONLY at an idle instant:
+        every submitted batch is accounted, so no shard holds backlog a
+        re-keyed submission could overtake (per-key publish order is
+        the invariant the sync fast path and the partition hash share).
+        The stage drains its whole backlog per wakeup, so idle instants
+        are frequent even under load; until one arrives the decision
+        stays pending and `submit` retries it."""
+        if self._pending_active is None or not self.idle:
+            return
+        self.active = self._pending_active
+        self._pending_active = None
+        self.autotune_adjusts.inc()
+        self.autotune_gauge.set(self.active)
+        logger.info("egress[%s]: auto-tuned to %d active lane(s) of %d",
+                    self.engine.tenant_id, self.active, len(self.shards))
 
     # unpublished batches per shard before the consumer loops stop
     # consuming (backlogged below): a slow-but-not-failing publish (a
@@ -210,7 +320,11 @@ class EgressStage:
         """Egress backlog at capacity: the consumer loops consult this
         (through the commit barrier) exactly like the scoring sink's
         `backlogged` — stop consuming, keep draining, offsets hold."""
-        return self.backlog >= self.MAX_BACKLOG_PER_SHARD * len(self.shards)
+        # active lanes, not built shards: an auto-tuned stage's idle
+        # ceiling shards can't drain anything, so they must not widen
+        # the backpressure bound either
+        return self.backlog >= self.MAX_BACKLOG_PER_SHARD * max(self.active,
+                                                                1)
 
     @property
     def idle(self) -> bool:
@@ -223,11 +337,12 @@ class EgressStage:
         self.submit(scored)
 
     def submit(self, scored) -> None:
+        self._apply_pending()  # a decided lane switch lands idle-only
         key = getattr(scored.ctx, "source", None)
-        if key and len(self.shards) > 1:
+        if key and self.active > 1:
             # THE bus partition hash (kernel/bus.py key_hash): one key,
             # one shard, one partition — per-device publish order holds
-            shard = self.shards[key_hash(key) % len(self.shards)]
+            shard = self.shards[key_hash(key) % self.active]
         else:
             shard = self.shards[0]
         self.submitted += 1

@@ -156,6 +156,11 @@ class TelemetryBeat(BackgroundTaskComponent):
         self._lag_groups = set(lags)
         lag_max = max(lags.values(), default=0)
         self.lag_gauge.set(lag_max)
+        # flow mode + pressure per tenant (the shed ladder's live state)
+        # — sampled BEFORE the engine walk so the egress lane tuner
+        # sees this beat's modes, not the previous beat's
+        flow = getattr(runtime, "flow", None)
+        modes = flow.modes() if flow is not None else {}
         # egress backlog + scoring occupancy per rule-processing engine
         egress: dict[str, int] = {}
         scoring: dict[str, dict] = {}
@@ -167,6 +172,13 @@ class TelemetryBeat(BackgroundTaskComponent):
                     egress[tid] = stage.backlog
                     metrics.gauge(f"observe.egress_backlog:{tid}").set(
                         stage.backlog)
+                    # the egress lane auto-tuner's observation hook
+                    # (kernel/egresslane.py): one beat's signals — the
+                    # stage's own backlog, this loop-lag probe, the
+                    # tenant's shed mode — drive the lane count
+                    stage.autotune_observe(
+                        loop_lag_s, self.stall_s,
+                        mode=(modes.get(tid) or {}).get("mode", "ok"))
                 sink = getattr(eng, "session", None) \
                     or getattr(eng, "pool_slot", None)
                 if sink is not None:
@@ -180,9 +192,6 @@ class TelemetryBeat(BackgroundTaskComponent):
         self.pending_gauge.set(sum(s["pending"] for s in scoring.values()))
         self.inflight_gauge.set(
             sum(s["inflight"] for s in scoring.values()))
-        # flow mode + pressure per tenant (the shed ladder's live state)
-        flow = getattr(runtime, "flow", None)
-        modes = flow.modes() if flow is not None else {}
         sample = {
             "t": time.time(),
             "loop_lag_ms": round(loop_lag_s * 1e3, 3),

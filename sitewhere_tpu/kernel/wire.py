@@ -327,8 +327,13 @@ class BusServer(WireServer):
                                       fence=msg.get("fence"))
 
     async def _op_subscribe(self, msg, writer=None) -> int:
+        # `owner` tags the membership with the fleet worker id, so a
+        # controller death declaration evicts the dead worker's members
+        # broker-side (EventBus.evict_owner) instead of letting a
+        # SIGSTOPped zombie stall its partitions until SIGCONT
         consumer = self.bus.subscribe(msg["topics"], group=msg["group"],
-                                      name=msg.get("name"))
+                                      name=msg.get("name"),
+                                      owner=msg.get("owner"))
         cid = next(self._cids)
         self._consumers[cid] = consumer
         if writer is not None:
@@ -479,6 +484,10 @@ class RemoteEventBus:
     def __init__(self, host: str, port: int, secret: Optional[str] = None):
         self.host, self.port = host, port
         self._client = WireClient(host, port, secret=secret)
+        # fleet worker id: set by the worker entry (fleet/worker_main)
+        # so every membership this process registers is owner-tagged —
+        # the broker's death-declaration eviction needs the attribution
+        self.owner: Optional[str] = None
 
     # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
     async def initialize(self) -> None:
@@ -540,22 +549,25 @@ class RemoteEventBus:
                          fence=fence))
 
     def subscribe(self, topics: Iterable[str] | str, *, group: str,
-                  name: Optional[str] = None):
+                  name: Optional[str] = None,
+                  owner: Optional[str] = None):
         # subscribe must return a consumer synchronously (services
         # subscribe in sync setup paths); the RPC resolves lazily via a
         # proxy that binds cid on first poll
         if isinstance(topics, str):
             topics = [topics]
         return _LazyRemoteConsumer(self._client, list(topics), group,
-                                   name or group)
+                                   name or group,
+                                   owner=owner or self.owner)
 
 
 class _LazyRemoteConsumer(RemoteBusConsumer):
     """RemoteBusConsumer that performs the subscribe RPC on first use."""
 
     def __init__(self, client: WireClient, topics: list, group: str,
-                 name: str):
+                 name: str, owner: Optional[str] = None):
         super().__init__(client, cid=-1, group=group, name=name)
+        self.owner = owner
         self._topics = topics
         self._seek_pending = False
 
@@ -563,7 +575,7 @@ class _LazyRemoteConsumer(RemoteBusConsumer):
         if self.cid < 0:
             self.cid = await self._client.call(
                 "subscribe", topics=self._topics, group=self.group,
-                name=self.name)
+                name=self.name, owner=self.owner)
             if self._seek_pending:
                 self._seek_pending = False
                 await self._client.call("seek_begin", cid=self.cid)

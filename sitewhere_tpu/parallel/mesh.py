@@ -17,11 +17,14 @@ including the CPU host-platform mesh used by tests and the driver's
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -40,6 +43,51 @@ def make_mesh(data: Optional[int] = None, model: int = 1,
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def mesh_from_spec(spec: Optional[dict]) -> Optional[Mesh]:
+    """Build the serving mesh from a `{data: D, model: M}` config spec,
+    degrading gracefully to whatever THIS process actually has — the
+    contract that lets one config serve the 1-core CI rig and a TPU pod
+    (docs/PERFORMANCE.md mesh serving):
+
+    - exact fit (D×M == devices): the requested mesh;
+    - fewer devices: shrink the model axis to the largest divisor of
+      the device count ≤ M (tenant shards must tile the axis), data
+      takes the rest — the axis ROLES survive even when the shape
+      can't;
+    - one device (or no/empty spec): None — the single-chip degenerate
+      case where the stacked dispatch is simply device-resident.
+
+    More devices than the spec asks for uses only D×M of them (an
+    explicit spec is a budget, not a floor)."""
+    if not spec:
+        return None
+    model = max(int(spec.get("model", 1) or 1), 1)
+    data = spec.get("data")
+    devices = jax.devices()
+    n = len(devices)
+    if n <= 1:
+        if int(spec.get("data") or 1) * int(spec.get("model") or 1) > 1:
+            # the other degrade branch logs its fit; a spec collapsing
+            # all the way to meshless must be just as loud, or an A/B's
+            # "mesh on" leg can silently measure the off configuration
+            logger.warning(
+                "scoring mesh spec %s: this process has %d device(s) — "
+                "running meshless (single-device stacked dispatch)",
+                spec, n)
+        return None
+    want = (int(data) if data else max(n // model, 1)) * model
+    if want > n:
+        model = min(model, n)
+        while n % model:
+            model -= 1
+        logger.warning(
+            "scoring mesh spec %s wants %d devices, have %d — fitting "
+            "{data: %d, model: %d}", spec, want, n, n // model, model)
+        return make_mesh(data=n // model, model=model, devices=devices)
+    return make_mesh(data=want // model, model=model,
+                     devices=devices[:want])
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
@@ -52,6 +100,29 @@ def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
 def tenant_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Shard the leading (tenant) dim over `model`."""
     return NamedSharding(mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
+
+
+def megabatch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Sharding for the pooled `[T_cap, B, ...]` megabatch inputs:
+    tenant rows over `model` (co-sharded with the stacked params and
+    rings), batch columns over `data`. One definition shared by the
+    stacked rings (scoring/ring.py, scoring/stream.py) and the param
+    stack's query path so the dispatch inputs can never be placed
+    differently from the state they update."""
+    return NamedSharding(
+        mesh, P(MODEL_AXIS, DATA_AXIS, *([None] * (ndim - 2))))
+
+
+def megabatch_placer(mesh: Optional[Mesh]):
+    """`place(leaf)` for megabatch dispatch inputs — `jnp.asarray` when
+    there is no mesh (the single-device stacked dispatch), the sharded
+    device_put otherwise."""
+    import jax.numpy as jnp
+
+    if mesh is None:
+        return jnp.asarray
+    return lambda leaf: jax.device_put(leaf, megabatch_sharding(mesh,
+                                                                leaf.ndim))
 
 
 def tenant_placer(mesh: Optional[Mesh]):

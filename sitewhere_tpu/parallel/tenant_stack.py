@@ -96,8 +96,9 @@ class TenantStack:
     def _batch_sharding(self, ndim: int):
         if self.mesh is None:
             return None
-        return NamedSharding(
-            self.mesh, P(MODEL_AXIS, DATA_AXIS, *([None] * (ndim - 2))))
+        from sitewhere_tpu.parallel.mesh import megabatch_sharding
+
+        return megabatch_sharding(self.mesh, ndim)
 
     # -- capacity / slots ---------------------------------------------------
 
@@ -157,14 +158,33 @@ class TenantStack:
                 occ[slot] = True
         return occ
 
+    def _swap_fn(self) -> Callable:
+        """Compiled one-slot scatter with the OLD stack DONATED: the
+        swap aliases the stacked buffers in place (no full-stack copy
+        per leaf, no host round-trip) and — because jit propagates the
+        input sharding through the alias — the mesh placement survives
+        without an explicit re-place. Safe against in-flight megabatch
+        dispatches by construction: a dispatched jit holds its own
+        runtime reference to the buffers it read, so a donation landing
+        mid-flight degrades to a copy rather than tearing the stack."""
+        key = ("swap", self.capacity)
+        fn = self._fns.get(key)
+        if fn is None:
+
+            def swap(stacked, params, slot):
+                return jax.tree.map(
+                    lambda s, p: s.at[slot].set(p.astype(s.dtype)),
+                    stacked, params)
+
+            fn = self._fns[key] = jax.jit(swap, donate_argnums=(0,))
+        return fn
+
     def set_params(self, tenant_id: str, params: dict, *, _bump: bool = True) -> int:
         """Hot-swap one tenant's slice (checkpoint rollout): a device-side
         scatter; the rest of the stack is untouched."""
         slot = self.slots[tenant_id]
-        self.stacked = jax.tree.map(
-            lambda s, p: s.at[slot].set(p.astype(s.dtype)), self.stacked, params)
-        if self.mesh is not None:  # keep the shard placement committed
-            self.stacked = self._place_stack(self.stacked)
+        self.stacked = self._swap_fn()(self.stacked, params,
+                                       jnp.int32(slot))
         self.fence += 1
         if _bump:
             self.versions[tenant_id] += 1

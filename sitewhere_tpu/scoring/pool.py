@@ -101,6 +101,16 @@ class PoolConfig:
     # width, not device FLOPs — leftover tenants flush in the
     # immediately following round.
     max_tenants: int = 0
+    # adaptive megabatch window (the self-tuning dispatch half of mesh
+    # serving): let the LIVE close deadline float in
+    # [window_s, WINDOW_SPAN × window_s], keyed to the active-tenant
+    # count vs the observed tenants-per-dispatch occupancy — a sparse
+    # fleet whose rounds keep closing under-packed earns a wider
+    # aggregation window; a dense fleet converges back to the
+    # configured floor. `window_s` stays the floor either way, so the
+    # configured latency budget is never undercut and a 1-tenant pool
+    # never pays tuning it can't use.
+    window_auto: bool = True
 
     @property
     def backlog_events(self) -> int:
@@ -293,6 +303,29 @@ class SharedScoringPool:
         self.stage_batch = metrics.histogram("scoring.stage_batch_s")
         self.stage_device = metrics.histogram("scoring.stage_device_s")
         self.stage_sink = metrics.histogram("scoring.stage_sink_s")
+        # mesh-sharded serving observability: how many devices the
+        # stacked dispatch actually spans (0 = single-device), plus the
+        # adaptive-window state — the live close deadline and how many
+        # times the tuner moved it (the A/B artifact's auto-tuner
+        # decision count)
+        # per-pool suffix (one pool per model architecture; a shared
+        # base name would be last-writer-wins with several pools)
+        self.mesh_gauge = metrics.gauge(
+            f"scoring.mesh_devices:{model.name}")
+        self.mesh_gauge.set(mesh.size if mesh is not None else 0)
+        self._window_s = cfg.window_s
+        self.window_adjusts = metrics.counter(
+            "scoring.megabatch_window_adjusts")
+        self.window_gauge = metrics.gauge(
+            f"scoring.megabatch_window_ms:{model.name}")
+        self.window_gauge.set(self._window_s * 1e3)
+        # window-tuner observation state: tenants that ADMITTED since
+        # the last evaluation (idle registered tenants must not count
+        # — they have no columns a wider window could aggregate) + the
+        # packed-tenant sum over the evaluation period
+        self._tuner_tenants: set[str] = set()
+        self._packed_sum = 0.0
+        self._rounds_since_adjust = 0
 
     @property
     def settled_through(self) -> int:
@@ -407,7 +440,11 @@ class SharedScoringPool:
         async def attempt():
             while True:
                 key = self._current_key()
-                for b in self.cfg.batch_buckets:
+                # the same data-axis-padded widths the flush rounds
+                # dispatch (_bucket_for), so warmup compiles the exact
+                # shapes the hot path will hit
+                for b in (self.stack.pad_batch(b0)
+                          for b0 in self.cfg.batch_buckets):
                     dev = np.full((self.ring.t_cap, b), self.ring.device_cap,
                                   np.int32)
                     v = np.zeros((self.ring.t_cap, b), np.float32)
@@ -443,6 +480,11 @@ class SharedScoringPool:
             # quarantine — the record dead-letters with provenance and
             # nothing was taken yet, so nothing is lost
             self.faults.check("scoring.megabatch")
+            if self.mesh is not None:
+                # the mesh-sharded dispatch's own chaos seam: same
+                # quarantine contract, armed only when scoring actually
+                # rides a device mesh
+                self.faults.check("scoring.mesh")
         mask = batch.mtype == self.cfg.mtype
         if mask.all():
             dev, val, ts = batch.device_index, batch.value, batch.ts
@@ -453,13 +495,20 @@ class SharedScoringPool:
             return
         now = time.monotonic()
         self.stage_admit.observe(now - batch.ctx.ingest_monotonic)
+        if self.cfg.window_auto:
+            # window tuner: live traffic (guarded — with the tuner off
+            # _tune_window never reaches its periodic clear, and the
+            # set would grow without bound under tenant churn)
+            self._tuner_tenants.add(tenant_id)
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
         entry.pending.append((dev, val, ts, ingest, batch.ctx, now))
         entry.pending_n += dev.shape[0]
         if dev.shape[0]:
             self._pending_max = max(self._pending_max, int(dev.max()))
         if self._deadline is None:
-            self._deadline = time.monotonic() + self.cfg.window_s
+            # the LIVE window (adaptive when cfg.window_auto): the
+            # tuner floats it above the configured floor, never below
+            self._deadline = time.monotonic() + self._window_s
         self._wake.set()
 
     # -- flushing -----------------------------------------------------------
@@ -490,8 +539,59 @@ class SharedScoringPool:
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.batch_buckets:
             if n <= b:
-                return b
-        return self.cfg.batch_buckets[-1]
+                return self.stack.pad_batch(b)
+        # a data-axis multiple either way: the batch columns shard over
+        # the mesh `data` axis, and an uneven split would silently
+        # gather the ragged tail onto one device
+        return self.stack.pad_batch(self.cfg.batch_buckets[-1])
+
+    # -- adaptive megabatch window (self-tuning dispatch) -------------------
+
+    # widen at most to 8× the configured floor; adjust geometrically, at
+    # most once per 16 flush rounds, and only OUTSIDE the [0.5, 0.9]
+    # occupancy band — the hysteresis gap that makes the tuner converge
+    # instead of flapping between widen and narrow (test-pinned)
+    WINDOW_SPAN = 8.0
+    WINDOW_ADJUST_EVERY = 16
+
+    def _tune_window(self, packed: int) -> None:
+        """Fold one closed megabatch's occupancy into the window tuner:
+        every WINDOW_ADJUST_EVERY rounds, compare the mean
+        tenants-per-dispatch against the tenants that ACTUALLY admitted
+        during the period (`_tuner_tenants`, fed by `admit` — idle
+        registered tenants have no columns a wider window could
+        aggregate, so they must not drag the occupancy down and pin the
+        window at the cap for nothing). Under-packed periods mean the
+        window closed before live tenants' columns arrived — widen so
+        aggregation (the dispatch-rate collapse) recovers; near-full
+        periods mean the window is not the binding constraint — narrow
+        back toward the configured floor and give the latency back."""
+        if not self.cfg.window_auto:
+            return
+        self._packed_sum += packed
+        self._rounds_since_adjust += 1
+        if self._rounds_since_adjust < self.WINDOW_ADJUST_EVERY:
+            return
+        active = len(self._tuner_tenants)
+        if self.cfg.max_tenants:
+            active = min(active, self.cfg.max_tenants)
+        mean_packed = self._packed_sum / self._rounds_since_adjust
+        self._packed_sum = 0.0
+        self._rounds_since_adjust = 0
+        self._tuner_tenants.clear()
+        if active <= 1:
+            return  # one live tenant: nothing to aggregate, floor holds
+        frac = mean_packed / active
+        base = self.cfg.window_s
+        if frac < 0.5 and self._window_s < base * self.WINDOW_SPAN:
+            self._window_s = min(self._window_s * 1.5,
+                                 base * self.WINDOW_SPAN)
+        elif frac > 0.9 and self._window_s > base:
+            self._window_s = max(self._window_s * 0.67, base)
+        else:
+            return  # in the hysteresis band (or pinned at a bound): hold
+        self.window_adjusts.inc()
+        self.window_gauge.set(self._window_s * 1e3)
 
     @property
     def flush_due(self) -> bool:
@@ -711,6 +811,7 @@ class SharedScoringPool:
         self.dispatches.inc(len(dispatches))
         self.megabatch_dispatches.inc(len(dispatches))
         self.megabatch_tenants.observe(float(len(metas)))
+        self._tune_window(len(metas))
         if self.tracer is not None:
             # dispatch/settle split with megabatch tenant attribution:
             # every packed tenant's traces get a queue-wait span here

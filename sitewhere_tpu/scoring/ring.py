@@ -218,7 +218,10 @@ class StackedDeviceRing:
 
     def __init__(self, window: int, n_tenants: int, device_cap: int = 1024,
                  mesh=None, score_dtype=None):
-        from sitewhere_tpu.parallel.mesh import tenant_placer
+        from sitewhere_tpu.parallel.mesh import (
+            megabatch_placer,
+            tenant_placer,
+        )
 
         self.window = int(window)
         self.mesh = mesh
@@ -228,6 +231,10 @@ class StackedDeviceRing:
         self._fns: dict[tuple, Callable] = {}
         self.faulted = False
         self._place = tenant_placer(mesh)
+        # dispatch inputs ([T_cap, B] deltas) shard tenant-rows over
+        # `model` and batch-columns over `data` — the serving-mesh axis
+        # convention (parallel/mesh.py), XLA inserting the collectives
+        self._place_in = megabatch_placer(mesh)
         self._alloc()
 
     def _alloc(self) -> None:
@@ -299,8 +306,9 @@ class StackedDeviceRing:
 
     def _pad(self, dev: np.ndarray, v: np.ndarray) -> tuple:
         """dev/v are already [T_cap, B]; host fills padding with
-        device_cap (the scratch row) before calling."""
-        return (jnp.asarray(dev), jnp.asarray(v))
+        device_cap (the scratch row) before calling. Placement shards
+        them over the mesh (tenant→model, batch→data) when one exists."""
+        return (self._place_in(dev), self._place_in(v))
 
     def update_and_score(self, model, stacked_params, dev: np.ndarray,
                          v: np.ndarray) -> jax.Array:

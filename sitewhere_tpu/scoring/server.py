@@ -89,6 +89,11 @@ class ScoringConfig:
     # (0 → every due tenant). Inert on a dedicated session.
     megabatch_window_ms: float = 0.0
     megabatch_max_tenants: int = 0
+    # adaptive megabatch window (scoring/pool.py _tune_window): let the
+    # pool float its live close deadline above megabatch_window_ms,
+    # keyed to observed tenants-per-dispatch occupancy. Inert on a
+    # dedicated session.
+    megabatch_autotune: bool = True
 
     @property
     def backlog_events(self) -> int:

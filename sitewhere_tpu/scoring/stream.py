@@ -273,7 +273,10 @@ class StackedStreamingRing:
     def __init__(self, model, n_tenants: int, device_cap: int = 1024,
                  mesh=None, score_dtype=None, sparse: bool = False,
                  sparse_k: int = 0):
-        from sitewhere_tpu.parallel.mesh import tenant_placer
+        from sitewhere_tpu.parallel.mesh import (
+            megabatch_placer,
+            tenant_placer,
+        )
 
         self.model = model
         self.window = int(model.cfg.window)
@@ -289,6 +292,9 @@ class StackedStreamingRing:
         self._fns: dict[tuple, Callable] = {}
         self.faulted = False
         self._place = tenant_placer(mesh)
+        # [T_cap, B] dispatch deltas shard tenant→model, batch→data —
+        # the same serving-mesh convention as the stacked window ring
+        self._place_in = megabatch_placer(mesh)
         self.state = self._alloc(self.t_cap, self.device_cap)
 
     def _alloc(self, t: int, d: int):
@@ -387,12 +393,14 @@ class StackedStreamingRing:
         try:
             if self.sparse:
                 self.state, scores = fn(stacked_params, self.state,
-                                        jnp.asarray(dev), jnp.asarray(v),
+                                        self._place_in(dev),
+                                        self._place_in(v),
                                         jnp.asarray(thresholds,
                                                     jnp.float32))
             else:
                 self.state, scores = fn(stacked_params, self.state,
-                                        jnp.asarray(dev), jnp.asarray(v))
+                                        self._place_in(dev),
+                                        self._place_in(v))
         except Exception:
             self.faulted = True  # donated state is gone; needs reseeding
             raise

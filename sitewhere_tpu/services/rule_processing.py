@@ -21,7 +21,13 @@ Tenant config section `rule-processing`:
   batch_window_ms: 2.0
   emit_alerts: true
   shared: false          # true → score via the multi-tenant pool (config 4)
-  mesh: {data: 4, model: 2}   # optional TPU mesh for the shared pool
+  megabatch: {enabled: true, window_ms: 1.0, autotune: true}
+  mesh: {data: 4, model: 2}   # serving mesh for the shared pool —
+                              # tenant rows shard over `model`, batch
+                              # columns over `data`; falls back to the
+                              # instance `scoring_mesh_*` default and
+                              # fits itself to this process's devices
+                              # (parallel/mesh.mesh_from_spec)
 
 Two scoring modes [SURVEY.md §7 hard part b]:
 - dedicated (`shared: false`): a per-tenant `ScoringSession` — own
@@ -48,8 +54,10 @@ from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import (
     EgressStage,
     commit_barrier,
+    egress_autotune,
     egress_fused,
     egress_lanes,
+    egress_max_lanes,
 )
 from sitewhere_tpu.kernel.fastlane import (
     FastLane,
@@ -165,10 +173,22 @@ class RuleProcessingEngine(TenantEngine):
             megabatch_max_tenants=int(mb_cfg.get(
                 "max_tenants",
                 getattr(settings, "scoring_megabatch_max_tenants", 0))),
+            megabatch_autotune=bool(mb_cfg.get(
+                "autotune",
+                getattr(settings, "scoring_megabatch_autotune", True))),
         )
         self.emit_alerts: bool = cfg.get("emit_alerts", True)
         self.shared: bool = cfg.get("shared", False)
+        # serving mesh (parallel/mesh.py): tenant `mesh: {data, model}`
+        # over the instance default — the spec the shared pool shards
+        # its stacked dispatch over (fitted to the devices this process
+        # actually has; see mesh_from_spec)
         self.mesh_spec: Optional[dict] = cfg.get("mesh")
+        if self.mesh_spec is None:
+            d = int(getattr(settings, "scoring_mesh_data", 0) or 0)
+            m = int(getattr(settings, "scoring_mesh_model", 0) or 0)
+            if d or m:
+                self.mesh_spec = {"data": d or None, "model": m or 1}
         self.session: Optional[ScoringSession] = None
         self.pool_slot: Optional[TenantSlot] = None
         # fused egress stage (kernel/egresslane.py): scored publishes +
@@ -180,7 +200,9 @@ class RuleProcessingEngine(TenantEngine):
         self.egress: Optional[EgressStage] = None
         if self.model_name and egress_fused(tenant, self.runtime):
             self.egress = EgressStage(
-                self, lanes=egress_lanes(tenant, self.runtime))
+                self, lanes=egress_lanes(tenant, self.runtime),
+                autotune=egress_autotune(tenant, self.runtime),
+                max_lanes=egress_max_lanes(tenant, self.runtime))
             for shard in self.egress.shards:
                 self.add_child(shard)
         self.scored_sink = (self.egress if self.egress is not None
@@ -720,9 +742,10 @@ class RuleProcessingService(Service):
         if pool is None:
             mesh = None
             if mesh_spec:
-                from sitewhere_tpu.parallel.mesh import make_mesh
-                mesh = make_mesh(data=mesh_spec.get("data"),
-                                 model=mesh_spec.get("model", 1))
+                # fitted to THIS process's devices (1-core CI rigs run
+                # meshless off the same config a TPU pod shards on)
+                from sitewhere_tpu.parallel.mesh import mesh_from_spec
+                mesh = mesh_from_spec(mesh_spec)
             model = build_model(model_name, **model_config)
             # megabatch shaping knobs (window, tenants-per-dispatch,
             # inflight bound) are POOL-wide: the first registrant's
@@ -739,7 +762,8 @@ class RuleProcessingService(Service):
                            readback=scoring_cfg.readback,
                            sparse_k=scoring_cfg.sparse_k,
                            megabatch_window_ms=scoring_cfg.megabatch_window_ms,
-                           max_tenants=scoring_cfg.megabatch_max_tenants),
+                           max_tenants=scoring_cfg.megabatch_max_tenants,
+                           window_auto=scoring_cfg.megabatch_autotune),
                 mesh=mesh, tracer=self.runtime.tracer,
                 faults=self.runtime.faults)
             self._pools[key] = pool

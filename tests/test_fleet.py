@@ -904,3 +904,134 @@ def test_wire_group_lags_op(run):
         await server.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# broker-side member eviction on death declarations (kernel/bus.py)
+
+
+def test_broker_evicts_dead_workers_members(run):
+    """ROADMAP item 4's remaining thread, closed: a placement record
+    that DROPS a worker from the live list (the controller's death
+    declaration) evicts that worker's owner-tagged consumer-group
+    members broker-side — the zombie's partitions reassign to surviving
+    members NOW instead of stalling until SIGCONT, its late commits are
+    refused, and its polls read nothing through the stale assignment."""
+    import pytest
+
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.metrics import MetricsRegistry
+
+    async def main():
+        bus = EventBus(default_partitions=4)
+        bus.metrics = MetricsRegistry()
+        topic = "swx1.tenant.t0.outbound-enriched-events"
+        control = "swx1.instance.fleet-control"
+        zombie = bus.subscribe(topic, group="t0.rule-processing",
+                               owner="w0")
+        await bus.produce(control, {
+            "kind": "placement", "epoch": 1,
+            "assignment": {"t0": "w0"}, "prev": {},
+            "workers": ["w0", "w1"]}, key="placement")
+        for i in range(8):
+            await bus.produce(topic, {"n": i}, key=f"d{i}")
+        # the successor joins the SAME group: without eviction the
+        # rebalance splits partitions 2/2 with a member that can never
+        # poll again — half the topic stalls
+        successor = bus.subscribe(topic, group="t0.rule-processing",
+                                  owner="w1")
+        assert len(zombie.assignment) == 2
+        assert len(successor.assignment) == 2
+        # w0's own fleet-control subscription (broadcast group, no
+        # partition contention) must SURVIVE its eviction: a falsely
+        # declared worker that resumes still needs to see placements
+        control_sub = bus.subscribe(control, group="fleet.worker.w0",
+                                    owner="w0")
+        # the death declaration: w0 absent from the live-worker list
+        await bus.produce(control, {
+            "kind": "placement", "epoch": 2,
+            "assignment": {"t0": "w1"}, "prev": {"t0": "w0"},
+            "workers": ["w1"]}, key="placement")
+        assert zombie.evicted and zombie._closed
+        assert len(successor.assignment) == 4  # all partitions, now
+        assert bus.metrics.counter("fleet.members_evicted").value == 1
+        # the control subscription rode through: not evicted, still
+        # assigned, still reading (resumed workers stay reachable)
+        assert not control_sub.evicted and not control_sub._closed
+        assert control_sub.poll_nowait(max_records=8)
+        # the zombie's stale assignment reads nothing...
+        assert zombie.poll_nowait(max_records=64) == []
+        # ...and its late commit is refused (the unfenced-group analog
+        # of the data-path FencedError)
+        with pytest.raises(RuntimeError, match="evicted"):
+            zombie.commit({(topic, 0): 5})
+        # a FENCED commit still raises the TYPED error (fence checked
+        # BEFORE the eviction refusal): the wire client's on_fenced
+        # signal path — the worker's "you lost ownership" — survives
+        # eviction
+        from sitewhere_tpu.kernel.bus import FencedError
+
+        with pytest.raises(FencedError):
+            zombie.commit({(topic, 0): 5}, fence=["t0", 1, "w0"])
+        # the successor drains the whole topic
+        records = []
+        while True:
+            got = successor.poll_nowait(max_records=64)
+            if not got:
+                break
+            records.extend(got)
+        assert len(records) == 8
+        # a REJOINED worker's fresh members are untouched: eviction
+        # fires only on live-list DROP transitions
+        await bus.produce(control, {
+            "kind": "placement", "epoch": 3,
+            "assignment": {"t0": "w1"}, "prev": {"t0": "w1"},
+            "workers": ["w0", "w1"]}, key="placement")
+        fresh = bus.subscribe(topic, group="t0.rule-processing",
+                              owner="w0")
+        await bus.produce(control, {
+            "kind": "placement", "epoch": 4,
+            "assignment": {"t0": "w1"}, "prev": {"t0": "w1"},
+            "workers": ["w0", "w1"]}, key="placement")
+        assert not fresh.evicted
+        # a graceful leave (worker closed its consumers itself) makes
+        # the eviction a counted no-op
+        fresh.close()
+        await bus.produce(control, {
+            "kind": "placement", "epoch": 5,
+            "assignment": {"t0": "w1"}, "prev": {"t0": "w1"},
+            "workers": ["w1"]}, key="placement")
+        assert bus.metrics.counter("fleet.members_evicted").value == 1
+        successor.close()
+
+    run(main())
+
+
+def test_wire_subscribe_threads_owner_tag(run):
+    """A fleet worker's RemoteEventBus owner-tags every membership it
+    registers (fleet/worker_main sets bus.owner), so broker-side
+    eviction can attribute members to workers across the wire."""
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.wire import BusServer, RemoteEventBus
+
+    async def main():
+        bus = EventBus()
+        server = BusServer(bus)
+        await server.start()
+        remote = RemoteEventBus("127.0.0.1", server.port)
+        remote.owner = "w7"
+        await remote.initialize()
+        consumer = remote.subscribe("swx1.tenant.t0.inbound-events",
+                                    group="t0.inbound-processing")
+        await consumer.poll(max_records=1, timeout=0.05)  # binds the cid
+        members = bus._groups["t0.inbound-processing"].members
+        assert [m.owner for m in members] == ["w7"]
+        # eviction over the wire: the broker closes the member; the
+        # remote's next poll finds nothing and its commit is refused
+        assert bus.evict_owner("w7") == 1
+        assert await consumer.poll(max_records=8, timeout=0.05) == []
+        consumer.close()
+        await remote.stop()
+        await server.stop()
+
+    run(main())
