@@ -635,11 +635,19 @@ async def run_fleet_bench(args) -> dict:
     # driver runtime owns it, so broker-side `fence.rejections` count
     # on the driver's registry.
     bus = EventBus(default_partitions=4, retention=65536)
+    fleet_observe_on = not args.no_fleet_observe
     rt = ServiceRuntime(InstanceSettings(
         instance_id="fleet-bench", bus_retention=65536,
         engine_ready_timeout_s=args.ready_timeout,
         fleet_interval_s=0.25, fleet_dead_after_s=6.0,
-        flow_degrade_at=10.0, flow_defer_at=10.0), bus=bus)
+        flow_degrade_at=10.0, flow_defer_at=10.0,
+        # fleet observability plane (the fleetobs A/B lever): the
+        # FleetObserver + the controller-side durable telemetry
+        # history ride the ON leg; workers' telemetry export is
+        # toggled per worker below
+        fleet_observe=fleet_observe_on,
+        data_dir=(os.path.join(data_dir, "controller")
+                  if fleet_observe_on else None)), bus=bus)
     rt.add_service(EventSourcesService(rt))
 
     # tenant state tier — HERMETIC (docs/FLEET.md fencing protocol):
@@ -673,6 +681,11 @@ async def run_fleet_bench(args) -> dict:
                 "engine_ready_timeout_s": args.ready_timeout,
                 "fleet_heartbeat_s": 0.25,
                 "flow_degrade_at": 10.0, "flow_defer_at": 10.0,
+                # fleetobs A/B lever: the off leg's workers publish no
+                # telemetry beats (the per-process recorder itself
+                # stays on — that's the `observe` preset's lever)
+                "observe_export": fleet_observe_on,
+                "observe_history": fleet_observe_on,
                 # worker-LOCAL scratch (registry WAL + snapshots), one
                 # private dir per worker — NOT a shared mount: adoption
                 # state comes from bus replay (hermetic fleet)
@@ -1069,6 +1082,44 @@ async def run_fleet_bench(args) -> dict:
             }
 
         final = controller.snapshot()
+        # fleet-observe block (fleet/observer.py + the durable history
+        # tier): captured BEFORE teardown — the merged fleet critical
+        # path, telemetry-topic health, broker self-stats, and the
+        # per-tenant lag series the history tier persisted across the
+        # run (including across the kill drill's worker replacement —
+        # the controller-side store doesn't blink when a worker dies)
+        fleet_observe = None
+        if controller.observer is not None:
+            obs_snap = controller.observer.snapshot()
+            cp = obs_snap["critical_path"]
+            history_rows = {}
+            if rt.history is not None:
+                rt.history.flush()
+                history_rows = {
+                    tid: len(rt.history.history(tid, "lag"))
+                    for tid in tenant_ids}
+            broker_stats = obs_snap.get("broker") or {}
+            fleet_observe = {
+                "workers_reporting": len(obs_snap["workers"]),
+                "telemetry_records": obs_snap["telemetry"]["records"],
+                "telemetry_lag": obs_snap["telemetry"]["observer_lag"],
+                "workers_merged": cp.get("workers_merged", 0),
+                "queue_wait_p99_ms": cp["queue_wait_p99_ms"],
+                "service_p99_ms": cp["service_p99_ms"],
+                "critical_path": cp["stages"],
+                "mesh": obs_snap["mesh"],
+                "broker": {
+                    "topics": len(broker_stats.get("topics") or {}),
+                    "groups": len(broker_stats.get("groups") or {}),
+                    "fence_rejections": broker_stats.get(
+                        "fence_rejections", 0),
+                    "members_evicted": broker_stats.get(
+                        "members_evicted", 0),
+                },
+                "history": (rt.history.stats()
+                            if rt.history is not None else None),
+                "history_lag_windows_per_tenant": history_rows,
+            }
         for consumer in meters.values():
             consumer.close()
         chaos = None
@@ -1099,6 +1150,7 @@ async def run_fleet_bench(args) -> dict:
                                            if bus.fences is not None
                                            else 0),
                 "autoscaler_decisions": controller.decisions[-8:],
+                "observe": fleet_observe,
             },
             "saturation_trials": trials,
             "model": args.model,
@@ -2086,6 +2138,13 @@ def main() -> None:
     parser.add_argument("--no-fleet-kill", action="store_true",
                         help="skip the scripted mid-flood worker SIGKILL "
                              "drill in --workers mode")
+    parser.add_argument("--no-fleet-observe", action="store_true",
+                        help="--workers mode: disable the fleet "
+                             "observability plane (worker telemetry "
+                             "export + FleetObserver merge + durable "
+                             "history tier) — the fleetobs A/B's off "
+                             "leg; the per-process flight recorder "
+                             "stays on (that lever is --no-observe)")
     parser.add_argument("--zombie-drill", action="store_true",
                         help="--workers mode: SIGSTOP the busiest worker "
                              "past dead_after (false-positive death), "

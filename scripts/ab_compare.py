@@ -34,6 +34,13 @@ Presets (the levers bench.py exposes):
               window/lanes), off = the same megabatched tenants
               meshless — the mesh-serving A/B (per-device tflops +
               auto-tuner decision counts in the table)
+    fleetobs  on = `--workers N` (fleet observability plane: worker
+              telemetry export + FleetObserver merge + durable
+              history tier, docs/OBSERVABILITY.md), off =
+              `--workers N --no-fleet-observe` — SAME worker count
+              both legs, the plane's overhead A/B (acceptance:
+              saturation within 3%); the extra table reports the on
+              leg's fleet critical path + history counts
 
 Usage:
 
@@ -216,7 +223,7 @@ def main() -> int:
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
                                            "megabatch", "observe",
-                                           "fleet", "mesh"])
+                                           "fleet", "mesh", "fleetobs"])
     parser.add_argument("--mesh-shape", default="1x8",
                         help="DxM mesh for the mesh preset's on leg "
                              "(forced host-platform devices on CPU "
@@ -282,6 +289,15 @@ def main() -> int:
         pairs = [("w1", ["--workers", "1"]),
                  (f"w{w}", ["--workers", w])]
         names = ("fleet workers=1", f"fleet workers={w}")
+    elif args.preset == "fleetobs":
+        # SAME worker count both legs; the variable is the fleet
+        # observability plane (worker telemetry export + FleetObserver
+        # merge + durable history tier, docs/OBSERVABILITY.md) —
+        # acceptance: the on leg's saturation within 3% of off
+        w = str(args.workers)
+        pairs = [("off", ["--workers", w, "--no-fleet-observe"]),
+                 ("on", ["--workers", w])]
+        names = (f"fleet-observe off (w={w})", f"fleet-observe on (w={w})")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",
@@ -301,6 +317,29 @@ def main() -> int:
     b, a = artifacts  # baseline ran first (off / lanes1 / w1)
     if args.preset == "fleet":
         print(fleet_delta_table(names[1], a, names[0], b))
+    elif args.preset == "fleetobs":
+        print(fleet_delta_table(names[1], a, names[0], b))
+        obs = (a.get("fleet") or {}).get("observe") or {}
+        hist = obs.get("history") or {}
+        rows = [
+            ("workers reporting beats", obs.get("workers_reporting")),
+            ("telemetry records folded", obs.get("telemetry_records")),
+            ("telemetry-topic observer lag", obs.get("telemetry_lag")),
+            ("fleet critical-path stages",
+             len(obs.get("critical_path") or {})),
+            ("fleet queue-wait p99 (ms)", obs.get("queue_wait_p99_ms")),
+            ("fleet service p99 (ms)", obs.get("service_p99_ms")),
+            ("history series / windows / segments",
+             f"{hist.get('series')} / {hist.get('windows')} / "
+             f"{hist.get('segments')}"),
+            ("history lag windows per tenant",
+             obs.get("history_lag_windows_per_tenant")),
+        ]
+        print()
+        print("| fleet-observe (on leg) | value |")
+        print("|---|---|")
+        for m, v in rows:
+            print(f"| {m} | {v} |")
     else:
         print(delta_table(names[1], a, names[0], b))
     return 0

@@ -56,4 +56,14 @@ np.asarray(ring.update_and_score(model, stack.stacked, dev, val))
 print("mesh smoke: OK (8-device {data:4, model:2} stacked dispatch)")
 PY
 
+# fleet-observe smoke (docs/OBSERVABILITY.md fleet observability): a
+# 2-worker trace must stitch end-to-end — ONE origin-scoped trace id
+# whose spine (receive → wire hop → enrich → persist → dispatch →
+# score → publish) crosses REAL worker processes over the wire bus,
+# with the FleetObserver's merged critical path covering the worker
+# side. Marked `slow` so the bare ROADMAP tier-1 sweep (which runs
+# `-m 'not slow'`) doesn't pay the two jax-bearing subprocesses twice;
+# THIS gate runs it explicitly.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_observe.py -q -m slow -p no:cacheprovider || { echo "fleet-observe smoke: FAILED (2-worker trace does not stitch end-to-end)"; exit 1; }
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

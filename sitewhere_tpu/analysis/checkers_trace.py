@@ -47,7 +47,23 @@ TRACE_MODULES = frozenset({
     "sitewhere_tpu/scoring/server.py",
     "sitewhere_tpu/scoring/pool.py",
     "sitewhere_tpu/rest/api.py",
+    # fleet observability: the beat's telemetry export publishes on the
+    # same path it records its fleet.telemetry span
+    "sitewhere_tpu/kernel/observe.py",
 })
+
+# wire-boundary modules (the process-split data plane): a batch context
+# REBUILT here without threading `trace_id=` silently snaps the
+# cross-process trace back into per-process fragments — the exact
+# regression the fleet trace propagation exists to prevent. The codec
+# round-trips dataclass fields wholesale, so the live tree has no such
+# rebuild; this check keeps it that way.
+WIRE_MODULES = frozenset({
+    "sitewhere_tpu/kernel/wire.py",
+    "sitewhere_tpu/kernel/codec.py",
+})
+
+_CTX_CLASSES = {"BatchContext"}
 
 _EMIT_ATTRS = {"produce", "produce_nowait",
                "add_measurements", "add_locations"}
@@ -87,6 +103,37 @@ def check_trace_parity(module: Module, project: Project) -> Iterable[Finding]:
                      "\"<stage>\", ...)`) on the same path, or baseline "
                      "with a reason if the caller owns the span",
                 qualname=module.qualname_at(fn.lineno))
+
+
+def check_wire_trace_context(module: Module,
+                             project: Project) -> Iterable[Finding]:
+    """TRC01 at the wire boundary: constructing a fresh `BatchContext`
+    inside the wire/codec modules without `trace_id=` drops the trace
+    context a traveling batch carried — every downstream span lands on
+    id 0 and the fleet-stitched journey goes dark at the hop."""
+    if module.relpath not in WIRE_MODULES:
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name not in _CTX_CLASSES:
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if "trace_id" in kwargs or None in kwargs:  # **kwargs may carry it
+            continue
+        yield Finding(
+            path=module.relpath, line=node.lineno, code="TRC01",
+            message=(f"wire-boundary `{name}(...)` rebuild without "
+                     f"`trace_id=` — a batch crossing this hop loses "
+                     f"its trace context and the cross-process trace "
+                     f"fragments"),
+            hint="thread `trace_id=ctx.trace_id` (and the rest of the "
+                 "traveling context) through the rebuild, or baseline "
+                 "with a reason if this context never carries a trace",
+            qualname=module.qualname_at(node.lineno))
 
 
 def check_trace_stages(module: Module, project: Project) -> Iterable[Finding]:

@@ -172,11 +172,13 @@ def default_checkers() -> list[Checker]:
     from sitewhere_tpu.analysis.checkers_trace import (
         check_trace_parity,
         check_trace_stages,
+        check_wire_trace_context,
     )
 
     return [check_async_blocking, check_flow_consult, check_dlq_quarantine,
             check_fault_sites, check_metric_names, check_lifecycle_super,
-            check_trace_parity, check_trace_stages, check_fence_token]
+            check_trace_parity, check_trace_stages,
+            check_wire_trace_context, check_fence_token]
 
 
 # -- baseline ----------------------------------------------------------------

@@ -54,6 +54,14 @@ FAULT_SITES = frozenset({
 TRACE_STAGES: tuple[tuple[str, str], ...] = (
     ("event-sources.receive", "queue"),      # arrival → decode start
     ("event-sources.decode", "service"),     # SWB1/JSON decode
+    # wire-bus hop (kernel/wire.py): a split deployment's broker hop —
+    # produce is the append RPC (service), poll is the broker-retention
+    # wait between the append and the consuming worker's delivery
+    # (queue). Recorded client-side on each side of the socket, so a
+    # cross-process trace's queue-vs-service split covers the hop that
+    # used to be dark (docs/OBSERVABILITY.md fleet observability).
+    ("wire.produce", "service"),             # produce RPC → broker append
+    ("wire.poll", "queue"),                  # broker append → delivery
     ("inbound.enrich", "service"),           # mask validate + split
     ("event-management.persist", "service"), # columnar store scatter
     ("rule-processing.dispatch", "queue"),   # admission → jit dispatch
@@ -63,6 +71,10 @@ TRACE_STAGES: tuple[tuple[str, str], ...] = (
     ("flow.replay", "queue"),                # deferred drain re-admission
     ("dlq.quarantine", "service"),           # poison → dead-letter topic
     ("dlq.replay", "service"),               # dead letter → original topic
+    # fleet observability plane (kernel/observe.py): the beat's export
+    # publish onto the instance telemetry topic — its own trace family,
+    # so the recorder's overhead is itself visible in the span rings
+    ("fleet.telemetry", "service"),          # beat snapshot → telemetry topic
 )
 
 TRACE_STAGE_KINDS: dict[str, str] = dict(TRACE_STAGES)
@@ -143,6 +155,12 @@ COUNTERS = (
     # adaptive-megabatch-window and egress-lane tuner decisions
     "scoring.megabatch_window_adjusts",
     "egress.autotune_adjusts",
+    # fleet observability plane (docs/OBSERVABILITY.md): beat snapshots
+    # exported onto the instance telemetry topic, records the
+    # FleetObserver folded, telemetry-history windows compacted to disk
+    "observe.exports",
+    "observe.fleet_records",
+    "observe.history_windows",
 )
 
 GAUGES = (
@@ -164,6 +182,16 @@ GAUGES = (
     "scoring.mesh_devices",
     "scoring.megabatch_window_ms",
     "egress.autotune_lanes",
+    # per-device mesh telemetry (scoring/pool.py mesh_stats): tenant-row
+    # occupancy of the stacked dispatch and the LIVE per-device model
+    # throughput — the "read it on a real rig" surface, per-pool
+    # `:{model}` suffix like scoring.mesh_devices
+    "scoring.mesh_row_occupancy",
+    "scoring.model_tflops_per_device",
+    # fleet observability plane (fleet/observer.py): workers with a
+    # live beat on the telemetry topic, observer's own topic lag
+    "observe.fleet_workers",
+    "observe.telemetry_lag",
 )
 
 METERS = (

@@ -506,6 +506,19 @@ def render_top(report: dict) -> str:
     lines: list[str] = []
     beat = report.get("beat")
     cp = report.get("critical_path") or {}
+    # scope first: this view is ONE runtime. When this process hosts a
+    # fleet of workers, everything below describes only the local
+    # process (ingress + controller) — saying so stops the silent
+    # "where did my workers' stages go" misread (`swx top --fleet` is
+    # the merged view)
+    fleet_workers = ((report.get("fleet") or {}).get("workers") or {})
+    if fleet_workers:
+        lines.append(
+            f"scope: LOCAL runtime only — this host runs a fleet of "
+            f"{len(fleet_workers)} worker(s) whose stages/lag are NOT "
+            f"in the tables below; use `swx top --fleet` for the "
+            f"fleet-wide view")
+        lines.append("")
     if beat is None:
         lines.append("telemetry beat: DISABLED (observe_enabled=false)")
     else:
@@ -564,6 +577,98 @@ def render_top(report: dict) -> str:
     return "\n".join(lines)
 
 
+def render_fleet_top(report: dict) -> str:
+    """Render one fleet observe report (`GET /api/fleet/observe`,
+    fleet/observer.py) as the `swx top --fleet` screen: the merged
+    fleet critical path (queue-vs-service across process boundaries),
+    per-worker beat matrix, per-tenant lag matrix with owners, mesh
+    occupancy, broker stats. Pure function for tests."""
+    lines: list[str] = []
+    workers = report.get("workers") or {}
+    tele = report.get("telemetry") or {}
+    lines.append(
+        f"fleet observe — {len(workers)} worker(s) reporting  "
+        f"telemetry records {tele.get('records', 0)}  "
+        f"observer lag {tele.get('observer_lag', 0)}")
+    cp = report.get("critical_path") or {}
+    lines.append("")
+    lines.append(
+        f"fleet critical path ({cp.get('span_count', 0)} spans over "
+        f"{cp.get('workers_merged', 0)} process(es)) — queue-wait p99 "
+        f"{cp.get('queue_wait_p99_ms', 0):.2f}ms vs service p99 "
+        f"{cp.get('service_p99_ms', 0):.2f}ms")
+    lines.append(f"  {'stage':<28} {'kind':<8} {'count':>6} "
+                 f"{'p50ms':>8} {'p95ms':>8} {'p99ms':>8}")
+    for stage, row in (cp.get("stages") or {}).items():
+        lines.append(
+            f"  {stage:<28} {row.get('kind', '?'):<8} "
+            f"{row.get('count', 0):>6} {row.get('p50_ms', 0):>8.2f} "
+            f"{row.get('p95_ms', 0):>8.2f} {row.get('p99_ms', 0):>8.2f}")
+    if not cp.get("stages"):
+        lines.append("  (no merged spans yet)")
+    if workers:
+        lines.append("")
+        lines.append(f"  {'worker':<14} {'beats':>6} {'age':>6} "
+                     f"{'lag-ms':>7} {'stalls':>6} {'c-lag':>6} "
+                     f"{'egress':>7} {'pending':>8}")
+        for wid, w in sorted(workers.items()):
+            lines.append(
+                f"  {wid:<14} {w.get('beats', 0):>6} "
+                f"{w.get('beat_age_s', 0):>5.1f}s "
+                f"{w.get('loop_lag_ms', 0):>7.2f} "
+                f"{w.get('loop_stalls', 0):>6} "
+                f"{w.get('consumer_lag_max', 0):>6} "
+                f"{w.get('egress_backlog', 0):>7} "
+                f"{w.get('scoring_pending', 0):>8}")
+    matrix = report.get("lag_matrix") or {}
+    if matrix:
+        lines.append("")
+        lines.append(f"  {'tenant':<20} {'owner':<14} {'lag':>8}")
+        for tid, row in sorted(matrix.items(),
+                               key=lambda kv: -kv[1].get("lag", 0)):
+            lines.append(f"  {tid:<20} {row.get('worker') or '-':<14} "
+                         f"{row.get('lag', 0):>8}")
+    mesh = report.get("mesh") or {}
+    if mesh:
+        lines.append("")
+        lines.append(f"  {'worker':<14} {'model':<10} {'devices':>7} "
+                     f"{'rows':>9} {'occ':>6} {'win-ms':>7} "
+                     f"{'tflops/dev':>11}")
+        for wid, blocks in sorted(mesh.items()):
+            for b in blocks:
+                lines.append(
+                    f"  {wid:<14} {b.get('model', '?'):<10} "
+                    f"{b.get('devices', 0):>7} "
+                    f"{b.get('tenant_rows', 0):>4}/"
+                    f"{b.get('row_capacity', 0):<4} "
+                    f"{b.get('row_occupancy', 0):>6.2f} "
+                    f"{b.get('window_ms_live', 0):>7.2f} "
+                    f"{b.get('model_tflops_per_device', 0):>11.5f}")
+    broker = report.get("broker") or {}
+    if broker:
+        groups = broker.get("groups") or {}
+        hot = sorted(((g, s.get("lag", 0)) for g, s in groups.items()),
+                     key=lambda kv: -kv[1])[:6]
+        lines.append("")
+        lines.append(
+            f"broker: {len(broker.get('topics') or {})} topics  "
+            f"{len(groups)} groups  fence-rejections "
+            f"{broker.get('fence_rejections', 0)}  members-evicted "
+            f"{broker.get('members_evicted', 0)}")
+        for group, lag_n in hot:
+            if lag_n:
+                lines.append(f"  {group:<44} lag {lag_n:>8}")
+    history = report.get("history")
+    if history:
+        lines.append("")
+        lines.append(
+            f"history: {history.get('series', 0)} series  "
+            f"{history.get('windows', 0)} windows  "
+            f"{history.get('segments', 0)} segment(s)  "
+            f"window {history.get('window_s', 0):.0f}s")
+    return "\n".join(lines)
+
+
 def render_fleet(status: dict) -> str:
     """Render a fleet status dict (`GET /api/fleet`) — the `swx fleet
     status` / `swx top` placement view. Pure function for tests."""
@@ -607,9 +712,15 @@ async def cmd_top(args) -> int:
         headers = await _rest_login(args, "swx top")
         if headers is None:
             return 1
-        path = "/api/instance/observe"
-        if args.tenant:
-            path += f"?tenant={args.tenant}"
+        fleet_mode = bool(getattr(args, "fleet", False))
+        if fleet_mode:
+            # fleet-wide view: served only by the controller host
+            # (fleet/observer.py); workers keep the per-process view
+            path = "/api/fleet/observe"
+        else:
+            path = "/api/instance/observe"
+            if args.tenant:
+                path += f"?tenant={args.tenant}"
         while True:
             status, report = await _http_json("GET", args.host, args.port,
                                               path, headers=headers)
@@ -624,8 +735,11 @@ async def cmd_top(args) -> int:
                     # clear + home, like top(1); --once keeps scrollback
                     print("\x1b[2J\x1b[H", end="")
                 print(f"swx top — {args.host}:{args.port}"
-                      + (f" tenant={args.tenant}" if args.tenant else ""))
-                print(render_top(report))
+                      + (" [fleet]" if fleet_mode else "")
+                      + (f" tenant={args.tenant}"
+                         if args.tenant and not fleet_mode else ""))
+                print(render_fleet_top(report) if fleet_mode
+                      else render_top(report))
             if args.once:
                 return 0
             await asyncio.sleep(max(args.interval, 0.2))
@@ -1022,6 +1136,10 @@ def main(argv=None) -> int:
                             "rendered table")
     p_top.add_argument("--tenant", default=None,
                        help="filter the critical path to one tenant")
+    p_top.add_argument("--fleet", action="store_true",
+                       help="fleet-wide view (merged critical path, "
+                            "per-worker beats, lag matrix) via "
+                            "/api/fleet/observe on the controller host")
     p_top.add_argument("--user", default="admin")
     p_top.add_argument("--password", default="password")
 

@@ -46,6 +46,29 @@ class InstanceSettings:
     observe_interval_ms: float = 250.0
     observe_ring: int = 256
     observe_stall_ms: float = 100.0
+    # fleet observability plane (docs/OBSERVABILITY.md): when export is
+    # on, every beat publishes its sample onto the bounded
+    # `<instance>.instance.telemetry` topic (per-stage span summaries
+    # ride along every `observe_export_stages_every`-th beat — walking
+    # the span rings per beat would cost more than the beat itself);
+    # the FleetObserver on the controller host folds the stream into
+    # the fleet-wide view. None = auto: on for fleet_managed workers,
+    # off elsewhere (a single-process runtime has nobody to tell).
+    observe_export: Optional[bool] = None
+    observe_export_stages_every: int = 8
+    # durable telemetry history (persistence/durable.py
+    # TelemetryHistory): per-tenant signal series compacted into
+    # `observe_history_window_s` windows under <data_dir>/telemetry —
+    # the train-from-history substrate the predictive autoscaler reads
+    # (ROADMAP item 2). Needs a data_dir; `observe_history: false`
+    # opts a durable runtime out.
+    observe_history: bool = True
+    observe_history_window_s: float = 10.0
+    # controller-host lever for the fleet MERGE specifically (the
+    # FleetObserver beside the FleetController): `observe_enabled`
+    # turns the whole recorder off; this turns off only the fleet-wide
+    # fold — bench `--no-fleet-observe` is the fleetobs A/B's off leg
+    fleet_observe: bool = True
     scoring_batch_window_ms: float = 2.0
     scoring_batch_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
     # cross-tenant megabatched scoring (scoring/pool.py): when enabled,

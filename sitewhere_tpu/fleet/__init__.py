@@ -32,6 +32,8 @@ offsets. docs/FLEET.md is the operator runbook.
 """
 
 from sitewhere_tpu.fleet.controller import AutoscalerPolicy, FleetController
+from sitewhere_tpu.fleet.observer import FleetObserver
 from sitewhere_tpu.fleet.worker import FleetWorker
 
-__all__ = ["FleetController", "FleetWorker", "AutoscalerPolicy"]
+__all__ = ["FleetController", "FleetWorker", "AutoscalerPolicy",
+           "FleetObserver"]

@@ -122,6 +122,19 @@ class FleetController(LifecycleComponent):
         self._last_tick: Optional[float] = None
         self._loop = _ControllerLoop(self)
         self.add_child(self._loop)
+        # fleet observability plane (fleet/observer.py): the broker
+        # host folds every worker's exported telemetry beats into the
+        # fleet-wide critical path / lag matrix / mesh occupancy view
+        # (`GET /api/fleet/observe`, `swx top --fleet`); rides the
+        # runtime's observe lever — `observe_enabled: false` turns the
+        # whole recorder off, fleet merge included
+        self.observer = None
+        if getattr(settings, "observe_enabled", True) \
+                and getattr(settings, "fleet_observe", True):
+            from sitewhere_tpu.fleet.observer import FleetObserver
+
+            self.observer = FleetObserver(runtime)
+            self.add_child(self.observer)
         runtime.fleet = self  # REST `GET /api/fleet` + observe surface
 
     # -- tenant roster (the fleet's source of truth) -------------------------

@@ -87,6 +87,12 @@ class FleetWorker(LifecycleComponent):
         self._fenced_at: dict[str, int] = {}
         runtime.fence.worker_id = worker_id
         runtime.fence.on_lost = self._on_fence_lost
+        # fleet-wide trace identity (kernel/tracing.py): ids this worker
+        # MINTS carry its origin in the high bits, so a fleet-merged
+        # trace view can never conflate two workers' dense counters —
+        # ids stamped elsewhere (the ingress host) ride batches through
+        # unchanged, ONE trace id across the whole spine
+        runtime.tracer.set_origin(worker_id)
         self._dirty = asyncio.Event()
         self._seq = 0
         self._control = _WorkerControlLoop(self)
@@ -177,6 +183,16 @@ class FleetWorker(LifecycleComponent):
                 s.get("pending", 0) for s in scoring.values())
             out["scoring_inflight"] = sum(
                 s.get("inflight", 0) for s in scoring.values())
+            mesh = sample.get("mesh") or []
+            if mesh:
+                # per-device mesh telemetry (scoring/pool.py
+                # mesh_stats): the dispatch path's occupancy + live
+                # tflops ride every heartbeat, so the controller (and
+                # `swx fleet status`) read the SPMD serving state live
+                out["mesh_occupancy"] = max(
+                    b.get("row_occupancy", 0.0) for b in mesh)
+                out["model_tflops_per_device"] = max(
+                    b.get("model_tflops_per_device", 0.0) for b in mesh)
         return out
 
     async def heartbeat(self) -> None:

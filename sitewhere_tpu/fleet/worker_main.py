@@ -95,6 +95,18 @@ def build_runtime(cfg: dict):
 async def amain(cfg: dict) -> int:
     rt, worker = build_runtime(cfg)
     await rt.start()
+    api = None
+    if cfg.get("api_port") is not None:
+        # per-worker control/query plane (kernel/wire.py ApiServer):
+        # observe/trace/health ops for fleet tooling — the trace op is
+        # how a cross-process trace is stitched (tests, tier1 smoke)
+        from sitewhere_tpu.kernel.wire import ApiServer
+
+        api = ApiServer(rt, port=int(cfg["api_port"]),
+                        secret=cfg.get("secret"))
+        await api.start()
+        print(f"FLEET-WORKER {cfg['worker_id']} api-port {api.port}",
+              flush=True)
     print(f"FLEET-WORKER {cfg['worker_id']} up", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -110,6 +122,8 @@ async def amain(cfg: dict) -> int:
             pass
     if worker.retired:
         print(f"FLEET-WORKER {cfg['worker_id']} retired", flush=True)
+    if api is not None:
+        await api.stop()
     await rt.stop()
     return 0
 

@@ -282,6 +282,10 @@ class EventBus(LifecycleComponent):
         # optional metrics registry (set by the runtime that OWNS this
         # bus) so fenced rejections surface as `fence.rejections`
         self.metrics = None
+        # broker self-stats (stats()): evictions counted on the bus
+        # itself beside the metrics counter, so the wire `bus_stats` op
+        # reports them even when no runtime wired a registry
+        self.members_evicted = 0
 
     # -- admin -------------------------------------------------------------
 
@@ -328,6 +332,39 @@ class EventBus(LifecycleComponent):
                     lags[topic_name] = total
             out[group] = lags
         return out
+
+    def stats(self) -> dict:
+        """The broker's OWN health surface (wire op `bus_stats`,
+        `GET /api/fleet` broker block): per-topic retained depth +
+        head offsets, per-group total lag + live member count, fence
+        rejections, members evicted. The broker used to be the one
+        fleet component with no stats of its own — every other signal
+        was inferred from the consumers around it."""
+        topics: dict[str, dict] = {}
+        for name, topic in sorted(self._topics.items()):
+            depth = sum(len(p.records) for p in topic.partitions)
+            topics[name] = {
+                "partitions": len(topic.partitions),
+                "depth": depth,
+                "end_offset": sum(p.end_offset for p in topic.partitions),
+                "retention": topic.retention,
+            }
+        lags = self.group_lags()
+        groups: dict[str, dict] = {}
+        for group, state in sorted(self._groups.items()):
+            groups[group] = {
+                "members": len(state.members),
+                "lag": sum((lags.get(group) or {}).values()),
+                "generation": state.generation,
+            }
+        return {
+            "topics": topics,
+            "groups": groups,
+            "fence_rejections": (self.fences.rejections
+                                 if self.fences is not None else 0),
+            "members_evicted": self.members_evicted,
+            "fleet_live": sorted(self._fleet_live or ()),
+        }
 
     def peek(self, topic: str, *, limit: int = 100) -> list[TopicRecord]:
         """Admin read: the newest `limit` retained records of `topic`
@@ -403,6 +440,7 @@ class EventBus(LifecycleComponent):
                 member.close()
                 evicted += 1
         if evicted:
+            self.members_evicted += evicted
             logger.warning(
                 "bus: evicted %d consumer-group member(s) of dead worker "
                 "%s; their partitions reassign now", evicted, owner)
@@ -727,6 +765,11 @@ class TopicNaming:
     TENANT_MODEL_UPDATES = "tenant-model-updates"
     INSTANCE_LOGS = "instance-logs"
     FLEET_CONTROL = "fleet-control"              # placement/heartbeats (fleet/)
+    INSTANCE_TELEMETRY = "telemetry"             # per-worker beat snapshots
+    #   (kernel/observe.py export → fleet/observer.py merge: each
+    #    worker's TelemetryBeat publishes its sample + span summaries
+    #    here; bounded like any topic — the observer folds the stream,
+    #    it never needs deep history)
 
     def __init__(self, instance_id: str):
         self.instance_id = instance_id

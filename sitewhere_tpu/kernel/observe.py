@@ -34,6 +34,17 @@ within noise; docs/OBSERVABILITY.md).
 critical-path analysis (kernel/tracing.py) into the one dict served by
 `GET /api/instance/observe`, rendered by `swx top`, and stamped into
 bench artifacts as the `observe` block.
+
+Fleet observability (docs/OBSERVABILITY.md): when export is on
+(`observe_export`, auto for fleet workers) every beat also PUBLISHES
+its sample — plus the tracer's mergeable per-stage span summaries every
+Nth beat — onto the bounded `<instance>.instance.telemetry` topic, and
+the broker-host's `FleetObserver` (fleet/observer.py) folds the stream
+into the fleet-wide critical path / lag matrix / mesh occupancy view.
+When the runtime has a durable telemetry history
+(`persistence/durable.py TelemetryHistory`, `runtime.history`), each
+sample's per-tenant signals append into it — the windowed series
+ROADMAP item 2's predictive autoscaler trains from.
 """
 
 from __future__ import annotations
@@ -44,9 +55,34 @@ import time
 from collections import deque
 from typing import Optional
 
+from sitewhere_tpu.kernel.bus import TopicNaming
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 
 logger = logging.getLogger(__name__)
+
+
+def per_tenant_lags(lags: dict, roster=None) -> dict[str, int]:
+    """Fold a `group_lags()` map into per-tenant totals. Tenant
+    consumer groups are `{tenant}.{service}`; the control/observer
+    plane's own groups live under the reserved first segment `fleet`
+    (`fleet.controller`, `fleet.worker.*`, `fleet.observer.*`) — a
+    TENANT named e.g. `fleetops` still counts. Pass `roster` (the
+    known tenant ids — `ServiceRuntime.tenants` / the controller's
+    roster) to also drop NON-tenant groups that happen to contain a
+    dot (service-internal groups, meter groups): without it the first
+    segment is taken on faith. One implementation for the beat's
+    history appends and the FleetObserver's lag matrix."""
+    out: dict[str, int] = {}
+    for group, by_topic in lags.items():
+        tid, _, rest = group.partition(".")
+        if not rest or tid == "fleet":
+            continue
+        if roster is not None and tid not in roster:
+            continue
+        total = (sum(by_topic.values())
+                 if isinstance(by_topic, dict) else int(by_topic))
+        out[tid] = out.get(tid, 0) + total
+    return out
 
 
 class TelemetryBeat(BackgroundTaskComponent):
@@ -87,6 +123,18 @@ class TelemetryBeat(BackgroundTaskComponent):
         # (wire: the broker owns that signal) — resolved ONCE, so a
         # wire-bus worker doesn't build-and-discard a coroutine per beat
         self._lags_local: Optional[bool] = None
+        # telemetry export (fleet observability plane): every beat's
+        # sample rides the bounded instance telemetry topic; span-stage
+        # summaries ride every Nth beat (walking the span rings costs
+        # more than the sample itself). Auto: on for fleet workers.
+        export = getattr(settings, "observe_export", None)
+        if export is None:
+            export = bool(getattr(settings, "fleet_managed", False))
+        self._export_topic = (runtime.naming.instance_topic(
+            TopicNaming.INSTANCE_TELEMETRY) if export else None)
+        self._export_stages_every = max(int(getattr(
+            settings, "observe_export_stages_every", 8)), 1)
+        self.exports = metrics.counter("observe.exports")
 
     async def _run(self) -> None:
         import asyncio
@@ -164,6 +212,7 @@ class TelemetryBeat(BackgroundTaskComponent):
         # egress backlog + scoring occupancy per rule-processing engine
         egress: dict[str, int] = {}
         scoring: dict[str, dict] = {}
+        pools: dict[int, object] = {}
         rp = runtime.services.get("rule-processing")
         if rp is not None:
             for tid, eng in rp.engines.items():
@@ -185,6 +234,9 @@ class TelemetryBeat(BackgroundTaskComponent):
                     scoring[tid] = {"pending": sink.pending_n,
                                     "inflight": getattr(sink, "inflight",
                                                         0)}
+                    pool = getattr(sink, "pool", None)
+                    if pool is not None:
+                        pools[id(pool)] = pool
         for gone in self._egress_tenants - set(egress):
             metrics.gauge(f"observe.egress_backlog:{gone}").set(0)
         self._egress_tenants = set(egress)
@@ -192,6 +244,12 @@ class TelemetryBeat(BackgroundTaskComponent):
         self.pending_gauge.set(sum(s["pending"] for s in scoring.values()))
         self.inflight_gauge.set(
             sum(s["inflight"] for s in scoring.values()))
+        # per-device mesh telemetry (scoring/pool.py mesh_stats): one
+        # block per shared pool — axis shape, tenant-row occupancy,
+        # live per-device tflops — so the SPMD dispatch path reports
+        # into every beat (and, via export, every worker heartbeat the
+        # fleet observer folds)
+        mesh = [pool.mesh_stats() for pool in pools.values()]
         sample = {
             "t": time.time(),
             "loop_lag_ms": round(loop_lag_s * 1e3, 3),
@@ -200,9 +258,84 @@ class TelemetryBeat(BackgroundTaskComponent):
             "egress_backlog": egress,
             "scoring": scoring,
             "flow": modes,
+            "mesh": mesh,
         }
         self.samples.append(sample)
+        self._append_history(sample, lags, egress, scoring)
+        if self._export_topic is not None:
+            self._export(sample)
         return sample
+
+    def _worker_key(self) -> str:
+        """This process's identity on the telemetry topic / in worker-
+        scoped history series: the fleet worker id when FleetWorker set
+        one (runtime.fence.worker_id), else the instance id (the
+        single-process / controller-host case)."""
+        fence = getattr(self.runtime, "fence", None)
+        return getattr(fence, "worker_id", None) \
+            or self.runtime.settings.instance_id
+
+    def _append_history(self, sample: dict, lags: dict, egress: dict,
+                        scoring: dict) -> None:
+        """Fold this sample's signals into the durable telemetry
+        history (persistence/durable.py), when the runtime has one:
+        per-tenant lag/egress-backlog/scoring-pending series plus this
+        worker's loop lag — ROADMAP item 2's training substrate."""
+        history = getattr(self.runtime, "history", None)
+        if history is None:
+            return
+        t = sample["t"]
+        # roster-filtered: the runtime's tenant map is the truth of
+        # what is a tenant — dotted non-tenant groups (service
+        # internals, ad-hoc meters) must not become phantom series
+        roster = getattr(self.runtime, "tenants", None) or None
+        for tid, v in per_tenant_lags(lags, roster=roster).items():
+            history.append(tid, "lag", float(v), t=t)
+        for tid, v in egress.items():
+            history.append(tid, "egress_backlog", float(v), t=t)
+        for tid, s in scoring.items():
+            history.append(tid, "scoring_pending",
+                           float(s.get("pending", 0)), t=t)
+        history.append(self._worker_key(), "loop_lag_ms",
+                       sample["loop_lag_ms"], t=t)
+
+    def _export(self, sample: dict) -> None:
+        """Publish this beat onto the instance telemetry topic (keyed
+        by worker id: one worker's stream stays partition-ordered).
+        Fire-and-forget — a beat must never block on the broker — and
+        failure-tolerant: telemetry export is an appendix, losing a
+        beat record loses nothing the next beat doesn't resend."""
+        wid = self._worker_key()
+        n = int(self.beats.value)
+        record = {
+            "kind": "beat",
+            "worker": wid,
+            "seq": n,
+            "t": sample["t"],
+            "sample": sample,
+            "beat": {
+                "interval_ms": round(self.interval_s * 1e3, 1),
+                "beats": n,
+                "loop_stalls": int(self.stalls.value),
+                "loop_lag_p99_ms": round(
+                    self.loop_lag.quantile(0.99) * 1e3, 3),
+            },
+        }
+        if (n - 1) % self._export_stages_every == 0:
+            # first beat, then every Nth after (every=1 → every beat)
+            record["stages"] = self.runtime.tracer.stage_export()
+        trace_id = self.runtime.tracer.new_trace_id()
+        t0 = time.monotonic()
+        try:
+            self.runtime.bus.produce_nowait(self._export_topic, record,
+                                            key=wid)
+        except RuntimeError:
+            return  # no running loop (sync test harness): skip export
+        self.exports.inc()
+        # the export's own span family: the recorder's overhead is
+        # itself visible in the rings (sampled like any stage)
+        self.runtime.tracer.record(trace_id, "fleet.telemetry", wid,
+                                   t0, time.monotonic() - t0, 0)
 
     # -- reporting -----------------------------------------------------------
 
@@ -234,8 +367,12 @@ def observe_report(runtime, tenant: Optional[str] = None) -> dict:
     bench artifacts."""
     beat = getattr(runtime, "beat", None)
     fleet = getattr(runtime, "fleet", None)
+    history = getattr(runtime, "history", None)
     return {
         "critical_path": runtime.tracer.critical_path(tenant=tenant),
         "beat": beat.snapshot() if beat is not None else None,
         "fleet": fleet.snapshot() if fleet is not None else None,
+        # durable telemetry history (persistence/durable.py): series/
+        # window/segment counts when this runtime persists its signals
+        "history": history.stats() if history is not None else None,
     }

@@ -397,6 +397,11 @@ class ServiceRuntime(LifecycleComponent):
             # wire bus: a fire-and-forget commit/produce rejection
             # surfaces through the client callback instead of a raise
             self.bus.on_fenced = self.fence.mark_fenced
+        if hasattr(self.bus, "tracer"):
+            # wire bus: the broker hop records wire.produce/wire.poll
+            # spans for traced batches (kernel/wire.py), so a split
+            # deployment's trace spine covers the hop between processes
+            self.bus.tracer = self.tracer
         # per-tenant flow control (kernel/flow.py): quotas, weighted-fair
         # inbound admission, overload shedding — every ingress edge and
         # the rule-processing shed path consult this
@@ -420,6 +425,25 @@ class ServiceRuntime(LifecycleComponent):
         # hosts it, so REST (`GET /api/fleet`) and the observe report
         # can surface placement without a service dependency
         self.fleet = None
+        # fleet observability plane (fleet/observer.py): the
+        # FleetObserver registers itself here on the broker host —
+        # `GET /api/fleet/observe` / `swx top --fleet`
+        self.fleet_observer = None
+        # durable telemetry history (persistence/durable.py): windowed
+        # per-tenant signal series under <data_dir>/telemetry — the
+        # beat appends every sample's signals; readback is the
+        # train-from-history substrate (ROADMAP item 2)
+        self.history = None
+        if settings.data_dir and getattr(settings, "observe_history",
+                                         True):
+            import os as _os
+
+            from sitewhere_tpu.persistence.durable import TelemetryHistory
+            self.history = TelemetryHistory(
+                _os.path.join(settings.data_dir, "telemetry"),
+                window_s=getattr(settings, "observe_history_window_s",
+                                 10.0),
+                metrics=self.metrics)
         self.tenants: dict[str, TenantConfig] = {}
         # chaos seam: a FaultInjector (kernel/faults.py) installed via
         # install_faults(); None in production — every consulted site
@@ -625,6 +649,10 @@ class ServiceRuntime(LifecycleComponent):
             await eb.stop()
         for remote in self.remotes.values():
             remote.channel.close()
+        if self.history is not None:
+            # flush the open telemetry windows to disk (the readback
+            # across a restart is the whole point of the tier)
+            self.history.close()
 
     def health(self) -> dict:
         return self.state_tree()

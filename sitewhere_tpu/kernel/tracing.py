@@ -33,6 +33,7 @@ stage).
 from __future__ import annotations
 
 import itertools
+import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Iterable, Optional
@@ -70,10 +71,31 @@ class Tracer:
                                else max(capacity // 8, 64))
         self._rings: dict[str, deque[Span]] = {}
         self._ids = itertools.count(1)
+        # fleet-wide id scope (set_origin): high bits of every id this
+        # process MINTS. 0 = unscoped (single-process deployments keep
+        # their small dense ids)
+        self._origin = 0
+
+    def set_origin(self, key: str) -> None:
+        """Scope trace ids minted HERE to this process: the high 31
+        bits become a hash of `key` (worker id), the low 32 bits stay
+        the dense counter. Two fleet processes can then never mint the
+        same id, so a fleet-merged trace view (`FleetObserver`,
+        `ApiServer` trace op) attributes every span unambiguously —
+        while `sampled()` stays a pure function of the id, so EVERY
+        process along a batch's journey makes the same record/skip
+        decision for a trace some other process stamped. Masked to 31
+        bits: the full id must stay inside the wire codec's i64."""
+        self._origin = (zlib.crc32(key.encode()) & 0x7FFFFFFF) << 32
+
+    @property
+    def origin(self) -> int:
+        return self._origin
 
     def new_trace_id(self) -> int:
-        """Dense trace ids (stamped at the receiver)."""
-        return next(self._ids)
+        """Dense trace ids (stamped at the receiver), origin-scoped
+        when `set_origin` ran (fleet workers)."""
+        return self._origin | next(self._ids)
 
     def sampled(self, trace_id: int) -> bool:
         return trace_id > 0 and trace_id % self.sample == 0
@@ -159,6 +181,30 @@ class Tracer:
             }
         return out
 
+    def stage_export(self, tenant: Optional[str] = None) -> dict[str, dict]:
+        """Per-stage summary in MERGEABLE form: histogram bucket counts
+        beside count/events/total/max. Per-worker p99s cannot be
+        averaged into a fleet p99 — bucket-wise histogram merge keeps
+        fleet quantiles exact to bucket resolution, which is what the
+        telemetry export publishes and `merge_stage_exports` folds
+        (kernel/observe.py beat → fleet/observer.py)."""
+        out: dict[str, dict] = {}
+        for stage in sorted(self._rings):
+            spans = [s for s in self._rings[stage]
+                     if tenant is None or s.tenant_id == tenant]
+            if not spans:
+                continue
+            hist, count, events, total = self._stage_hist(spans)
+            out[stage] = {
+                "count": count,
+                "events": events,
+                "total_s": total,
+                "max_s": hist._max,
+                "buckets": list(hist.buckets),
+                "counts": list(hist.counts),
+            }
+        return out
+
     def critical_path(self, tenant: Optional[str] = None) -> dict:
         """The critical-path report over sampled traces: per-stage
         quantiles in pipeline order, each stage classified queue vs
@@ -192,3 +238,83 @@ class Tracer:
             "service_p99_ms": round(service_p99, 3),
             "sample": self.sample,
         }
+
+
+def merge_stage_exports(exports: Iterable[dict]) -> dict:
+    """Fold per-process `stage_export` dicts into ONE fleet critical
+    path: bucket counts merge additively per stage, quantiles are read
+    off the merged histogram, and the queue-vs-service split is
+    computed exactly as `Tracer.critical_path` does locally — the
+    fleet-level answer to "where does paced p99 live" when the spine
+    crosses worker processes (fleet/observer.py)."""
+    from sitewhere_tpu.analysis.registry import TRACE_STAGES
+
+    merged: dict[str, dict] = {}
+    for export in exports:
+        for stage, row in (export or {}).items():
+            agg = merged.get(stage)
+            if agg is None:
+                agg = merged[stage] = {
+                    "count": 0, "events": 0, "total_s": 0.0, "max_s": 0.0,
+                    "buckets": list(row.get("buckets") or ()),
+                    "counts": [0] * len(row.get("counts") or ()),
+                    "mixed": False,
+                }
+            agg["count"] += int(row.get("count", 0))
+            agg["events"] += int(row.get("events", 0))
+            agg["total_s"] += float(row.get("total_s", 0.0))
+            agg["max_s"] = max(agg["max_s"], float(row.get("max_s", 0.0)))
+            counts = row.get("counts") or ()
+            if agg["mixed"]:
+                continue
+            if len(counts) == len(agg["counts"]):
+                for i, c in enumerate(counts):
+                    agg["counts"][i] += int(c)
+            else:
+                # bucket-shape drift across versions: bucket fidelity
+                # is unrecoverable for this stage — flag it ONCE and
+                # report quantiles as the max upper bound below, the
+                # same answer whatever order exports arrive in
+                agg["mixed"] = True
+    kinds = dict(TRACE_STAGES)
+    order = {name: i for i, (name, _) in enumerate(TRACE_STAGES)}
+    stages: dict[str, dict] = {}
+    queue_p99 = service_p99 = 0.0
+    span_count = 0
+    for stage in sorted(merged, key=lambda s: order.get(s, 1000)):
+        agg = merged[stage]
+        if agg["mixed"]:
+            # count-only merge: the honest quantile is unknowable, so
+            # every quantile reports the conservative max upper bound
+            q50 = q95 = q99 = agg["max_s"]
+        else:
+            hist = Histogram("stage", buckets=agg["buckets"] or None)
+            hist.counts = list(agg["counts"]) + [0] * (
+                len(hist.buckets) + 1 - len(agg["counts"]))
+            hist.count = agg["count"]
+            hist._max = agg["max_s"]
+            q50, q95, q99 = (hist.quantile(0.50), hist.quantile(0.95),
+                             hist.quantile(0.99))
+        kind = kinds.get(stage, "unknown")
+        row = {
+            "count": agg["count"],
+            "p50_ms": round(q50 * 1e3, 3),
+            "p95_ms": round(q95 * 1e3, 3),
+            "p99_ms": round(q99 * 1e3, 3),
+            "mean_ms": round(agg["total_s"] / max(agg["count"], 1) * 1e3, 3),
+            "max_ms": round(agg["max_s"] * 1e3, 3),
+            "events": agg["events"],
+            "kind": kind,
+        }
+        stages[stage] = row
+        span_count += agg["count"]
+        if kind == "queue":
+            queue_p99 += row["p99_ms"]
+        elif kind == "service":
+            service_p99 += row["p99_ms"]
+    return {
+        "stages": stages,
+        "span_count": span_count,
+        "queue_wait_p99_ms": round(queue_p99, 3),
+        "service_p99_ms": round(service_p99, 3),
+    }

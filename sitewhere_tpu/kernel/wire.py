@@ -315,6 +315,7 @@ class BusServer(WireServer):
             "end_offsets": self._op_end_offsets,
             "topic_names": self._op_topic_names,
             "group_lags": self._op_group_lags,
+            "bus_stats": self._op_bus_stats,
         }
 
     async def _op_produce(self, msg, writer=None) -> tuple[int, int]:
@@ -382,6 +383,13 @@ class BusServer(WireServer):
         # wants the broker's central view (observe/fleet tooling)
         return self.bus.group_lags()
 
+    async def _op_bus_stats(self, msg, writer=None) -> dict:
+        # the broker's own health surface (per-topic depth, per-group
+        # lag + membership, fence rejections, members evicted) — the
+        # FleetObserver / `GET /api/fleet` block that closes the
+        # "broker is a black box" gap (docs/OBSERVABILITY.md)
+        return self.bus.stats()
+
     def on_disconnect(self, writer: asyncio.StreamWriter) -> None:
         for cid in self._by_conn.pop(writer, ()):
             consumer = self._consumers.pop(cid, None)
@@ -392,11 +400,18 @@ class BusServer(WireServer):
 class RemoteBusConsumer:
     """Client-side consumer handle; mirrors `BusConsumer`'s surface."""
 
-    def __init__(self, client: WireClient, cid: int, group: str, name: str):
+    def __init__(self, client: WireClient, cid: int, group: str, name: str,
+                 tracer=None):
         self._client = client
         self.cid = cid
         self.group = group
         self.name = name
+        # trace spine (kernel/tracing.py): when the owning runtime set a
+        # tracer on the RemoteEventBus, every delivered record whose
+        # value carries a BatchContext records a `wire.poll` span — the
+        # broker-hop queue wait (append wall time → delivery) that used
+        # to be dark in a split deployment's critical path
+        self.tracer = tracer
         self._closed = False
         # delivered-through positions, tracked CLIENT-side: a bare
         # commit() must pin exactly what this process has been handed.
@@ -418,6 +433,7 @@ class RemoteBusConsumer:
                                        max_records=max_records,
                                        timeout=timeout)
         now = time.monotonic()
+        now_wall = time.time()
         out = []
         for t, p, off, key, value, ts in rows:
             # cross-process: the producer stamped ctx.ingest_monotonic in
@@ -428,6 +444,20 @@ class RemoteBusConsumer:
             ctx = getattr(value, "ctx", None)
             if ctx is not None and hasattr(ctx, "ingest_monotonic"):
                 ctx.ingest_monotonic = now
+                if self.tracer is not None and ctx.trace_id \
+                        and self.tracer.sampled(ctx.trace_id):
+                    # broker-hop queue wait: the record's append wall
+                    # timestamp vs delivery here. Wall clocks, because
+                    # no monotonic epoch spans processes — same-host
+                    # skew is µs; cross-host NTP skew is the documented
+                    # resolution floor (docs/OBSERVABILITY.md).
+                    wait = max(now_wall - ts, 0.0)
+                    try:
+                        n = len(value)
+                    except TypeError:
+                        n = 0
+                    self.tracer.record(ctx.trace_id, "wire.poll",
+                                       ctx.tenant_id, now - wait, wait, n)
             self._delivered[(t, p)] = off + 1
             out.append(TopicRecord(t, p, off, key, value, ts))
         return out
@@ -488,6 +518,11 @@ class RemoteEventBus:
         # so every membership this process registers is owner-tagged —
         # the broker's death-declaration eviction needs the attribution
         self.owner: Optional[str] = None
+        # trace spine: ServiceRuntime sets its Tracer here so the
+        # broker hop records `wire.produce` / `wire.poll` spans for
+        # traced batches — the cross-process trace stays ONE trace with
+        # the hop's queue wait attributed (docs/OBSERVABILITY.md)
+        self.tracer = None
 
     # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
     async def initialize(self) -> None:
@@ -520,6 +555,12 @@ class RemoteEventBus:
         lag centrally (kernel/observe.py)."""
         return self._client.call("group_lags")
 
+    def bus_stats(self):
+        """Awaitable broker self-stats (`EventBus.stats()`): per-topic
+        depth, per-group lag/membership, fence rejections, members
+        evicted — the broker-black-box closer, served to any peer."""
+        return self._client.call("bus_stats")
+
     @property
     def on_fenced(self):
         """Callback(tenant) for fire-and-forget fenced rejections —
@@ -535,9 +576,28 @@ class RemoteEventBus:
                       key: Optional[str] = None,
                       partition: Optional[int] = None,
                       fence=None) -> tuple[int, int]:
+        tracer = self.tracer
+        ctx = getattr(value, "ctx", None)
+        # the broker-hop's service half: encode + RPC + append
+        # (`wire.poll` on the consuming peer records the queue half).
+        # Gate on sampled() BEFORE touching the clock: the un-sampled
+        # common case pays one modulo, nothing more (measured: even two
+        # stray monotonic reads per produce show up at fleet
+        # saturation on the 1-core rig).
+        traced = (tracer is not None and ctx is not None
+                  and getattr(ctx, "trace_id", 0)
+                  and tracer.sampled(ctx.trace_id))
+        t0 = time.monotonic() if traced else 0.0
         p, off = await self._client.call("produce", topic=topic, value=value,
                                          key=key, partition=partition,
                                          fence=fence)
+        if traced:
+            try:
+                n = len(value)
+            except TypeError:
+                n = 0
+            tracer.record(ctx.trace_id, "wire.produce", ctx.tenant_id,
+                          t0, time.monotonic() - t0, n)
         return p, off
 
     def produce_nowait(self, topic: str, value: Any, *,
@@ -558,15 +618,17 @@ class RemoteEventBus:
             topics = [topics]
         return _LazyRemoteConsumer(self._client, list(topics), group,
                                    name or group,
-                                   owner=owner or self.owner)
+                                   owner=owner or self.owner,
+                                   tracer=self.tracer)
 
 
 class _LazyRemoteConsumer(RemoteBusConsumer):
     """RemoteBusConsumer that performs the subscribe RPC on first use."""
 
     def __init__(self, client: WireClient, topics: list, group: str,
-                 name: str, owner: Optional[str] = None):
-        super().__init__(client, cid=-1, group=group, name=name)
+                 name: str, owner: Optional[str] = None, tracer=None):
+        super().__init__(client, cid=-1, group=group, name=name,
+                         tracer=tracer)
         self.owner = owner
         self._topics = topics
         self._seek_pending = False
@@ -634,6 +696,7 @@ class ApiServer(WireServer):
             "health": self._op_health,
             "observe": self._op_observe,
             "fleet": self._op_fleet,
+            "trace": self._op_trace,
         }
 
     async def _op_wait_engine(self, msg, writer=None) -> bool:
@@ -684,6 +747,15 @@ class ApiServer(WireServer):
         if fleet is None:
             raise LookupError("no fleet controller in this process")
         return fleet.snapshot()
+
+    async def _op_trace(self, msg, writer=None) -> list:
+        """This process's recorded spans for ONE trace id — trace ids
+        are origin-scoped fleet-wide (Tracer.set_origin), so peers can
+        stitch a cross-process journey by asking each worker for the
+        same id and merging (tests + fleet tooling)."""
+        return [s.to_dict() for s in
+                self.runtime.tracer.trace(int(msg["trace_id"]),
+                                          tenant=msg.get("tenant"))]
 
 
 class RemoteEngineProxy:
@@ -737,6 +809,11 @@ class ApiChannel:
 
     async def fleet(self) -> dict:
         return await self._client.call("fleet")
+
+    async def trace(self, trace_id: int,
+                    tenant: Optional[str] = None) -> list:
+        return await self._client.call("trace", trace_id=trace_id,
+                                       tenant=tenant)
 
     def close(self) -> None:
         self._client.close()

@@ -51,6 +51,7 @@ _REC = struct.Struct("<IIB")
 RT_MEASUREMENTS = 1
 RT_LOCATIONS = 2
 RT_COLD = 3
+RT_TELEMETRY = 4   # TelemetryHistory compacted window rows
 
 _SEG_FMT = "events-{:08d}.seg"
 
@@ -281,6 +282,179 @@ class DurableEventLog:
                 logger.warning("replay handler failed for a record; "
                                "skipping", exc_info=True)
         return n
+
+
+# -- durable telemetry history (the fleet observability plane's cold tier) --
+
+
+class TelemetryHistory:
+    """Windowed, compacted telemetry time-series over a `SegmentLog`.
+
+    The flight recorder's live signals (per-tenant consumer lag, egress
+    backlog, scoring occupancy, loop lag) die with their bounded rings;
+    ROADMAP item 2's predictive autoscaler names exactly those series as
+    its training substrate. This store keeps them: `append()` folds raw
+    points into the CURRENT `window_s` aggregation window per
+    (tenant, signal) series — count/sum/min/max/last, the PMU
+    streaming-vs-historical split (arXiv 2512.22231) — and a window
+    that closes is appended as one codec row to the segment log (CRC
+    framing, bounded segments, torn-tail-tolerant replay: the
+    `SegmentLog` contract). Reads never touch disk: the replay on init
+    rebuilds a bounded in-memory index (`max_windows` per series), so
+    `history()` is a deque slice.
+
+    Hot-path discipline: `append()` is a dict update; disk IO happens
+    only when a window CLOSES (once per `window_s` per series, from the
+    telemetry beat / fleet observer loop — never from the event hot
+    path), and fsync stays rate-limited by the log's
+    `fsync_interval_s`. The crash bound is the open window plus at most
+    one fsync interval of closed rows — telemetry history is an
+    appendix, not a transaction log.
+    """
+
+    def __init__(self, directory: str, window_s: float = 10.0,
+                 segment_bytes: int = 1 << 20, max_segments: int = 64,
+                 max_windows: int = 4096, metrics=None):
+        self.window_s = max(float(window_s), 0.001)
+        self.max_windows = int(max_windows)
+        self.log = SegmentLog(directory, segment_bytes=segment_bytes,
+                              max_segments=max_segments)
+        self._open: dict[tuple[str, str], dict] = {}
+        self._series: dict[tuple[str, str], "deque"] = {}
+        self._windows_counter = (metrics.counter("observe.history_windows")
+                                 if metrics is not None else None)
+        self.replayed = self._replay_index()
+
+    # -- write path ----------------------------------------------------------
+
+    def append(self, tenant: str, signal: str, value: float,
+               t: Optional[float] = None) -> None:
+        """Fold one point into its series' current window (wall-clock
+        `t`, default now). Out-of-order points older than the open
+        window fold into it anyway — sub-window ordering is below this
+        store's resolution by design."""
+        import time
+
+        t = time.time() if t is None else float(t)
+        w = (t // self.window_s) * self.window_s
+        key = (tenant, signal)
+        cur = self._open.get(key)
+        if cur is not None and w > cur["window"]:
+            self._close(key, cur)
+            cur = None
+        if cur is None:
+            self._open[key] = {"tenant": tenant, "signal": signal,
+                               "window": w, "count": 1,
+                               "sum": float(value), "min": float(value),
+                               "max": float(value), "last": float(value)}
+            return
+        cur["count"] += 1
+        cur["sum"] += float(value)
+        cur["min"] = min(cur["min"], float(value))
+        cur["max"] = max(cur["max"], float(value))
+        cur["last"] = float(value)
+
+    def _close(self, key: tuple[str, str], row: dict) -> None:
+        from sitewhere_tpu.kernel import codec
+
+        ring = self._series.get(key)
+        if ring is None:
+            from collections import deque as _deque
+
+            ring = self._series[key] = _deque(maxlen=self.max_windows)
+        ring.append(dict(row))
+        if self._windows_counter is not None:
+            self._windows_counter.inc()
+        try:
+            self.log.append(RT_TELEMETRY, codec.encode(row))
+            self.log._sync()  # rate-limited by fsync_interval_s
+        except OSError:
+            logger.warning("telemetry history append failed; window "
+                           "kept in memory only", exc_info=True)
+
+    def flush(self) -> None:
+        """Close every OPEN window to the index + disk (shutdown, test
+        barriers). The next append on a flushed series starts a fresh
+        window — two rows for one window merge at read time."""
+        for key, row in list(self._open.items()):
+            self._close(key, row)
+        self._open.clear()
+
+    def close(self) -> None:
+        self.flush()
+        self.log.close()
+
+    # -- read path -----------------------------------------------------------
+
+    def _replay_index(self) -> int:
+        from collections import deque as _deque
+
+        from sitewhere_tpu.kernel import codec
+
+        n = 0
+        for rtype, payload in self.log.replay():
+            if rtype != RT_TELEMETRY:
+                continue
+            try:
+                row = codec.decode(payload)
+            except Exception:  # noqa: BLE001 - one bad row ≠ no history
+                logger.warning("telemetry history: undecodable row "
+                               "skipped", exc_info=True)
+                continue
+            key = (row.get("tenant"), row.get("signal"))
+            ring = self._series.get(key)
+            if ring is None:
+                ring = self._series[key] = _deque(maxlen=self.max_windows)
+            ring.append(row)
+            n += 1
+        return n
+
+    def series(self) -> list[tuple[str, str]]:
+        """Every (tenant, signal) series with at least one closed or
+        open window."""
+        return sorted(set(self._series) | set(self._open))
+
+    def history(self, tenant: str, signal: str, *,
+                since: float = 0.0, until: Optional[float] = None,
+                limit: int = -1) -> list[dict]:
+        """Window rows for one series, oldest first. Window semantics:
+        a row covers [window, window + window_s); `since` is inclusive
+        and `until` exclusive ON WINDOW START, so
+        `history(t, s, since=w0, until=w0 + n*window_s)` returns
+        exactly n windows' rows when all were written. The OPEN window
+        rides along (live tail); rows sharing a window start (a flush
+        split one) are merged."""
+        rows = list(self._series.get((tenant, signal), ()))
+        cur = self._open.get((tenant, signal))
+        if cur is not None:
+            rows.append(dict(cur))
+        by_window: dict[float, dict] = {}
+        for row in rows:
+            w = row["window"]
+            agg = by_window.get(w)
+            if agg is None:
+                by_window[w] = dict(row)
+            else:
+                agg["count"] += row["count"]
+                agg["sum"] += row["sum"]
+                agg["min"] = min(agg["min"], row["min"])
+                agg["max"] = max(agg["max"], row["max"])
+                agg["last"] = row["last"]  # rows arrive oldest-first
+        out = [by_window[w] for w in sorted(by_window)
+               if w >= since and (until is None or w < until)]
+        if limit >= 0:
+            out = out[-limit:] if limit else []
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "series": len(self.series()),
+            "windows": sum(len(r) for r in self._series.values()),
+            "open_windows": len(self._open),
+            "replayed": self.replayed,
+            "segments": len(self.log._segments()),
+            "window_s": self.window_s,
+        }
 
 
 # -- registry write-ahead log -----------------------------------------------

@@ -338,6 +338,18 @@ class RestServer(LifecycleComponent):
         # fleet control plane (sitewhere_tpu/fleet): placement epoch,
         # worker liveness, autoscaler decisions — `swx fleet status`
         r("GET", r"/api/fleet", self.get_fleet)
+        # fleet observability plane (fleet/observer.py): the merged
+        # per-worker beat view — fleet critical path, lag matrix, mesh
+        # occupancy, broker stats — `swx top --fleet`'s data source,
+        # plus the fleet-merged Prometheus exposition (one scrape on
+        # the controller host instead of N workers)
+        r("GET", r"/api/fleet/observe", self.get_fleet_observe)
+        r("GET", r"/api/fleet/metrics/prometheus",
+          self.get_fleet_prometheus)
+        # durable telemetry history (persistence/durable.py): windowed
+        # per-tenant signal series readback — ?tenant=&signal=&since=
+        # &until=&limit= (no params lists the available series)
+        r("GET", r"/api/instance/history", self.get_history)
         # pipeline tracing [SURVEY.md §5.1]; all three accept ?tenant=
         # and the listing endpoints paginate with ?limit=&offset=
         r("GET", r"/api/instance/traces", self.get_trace_summary)
@@ -522,11 +534,60 @@ class RestServer(LifecycleComponent):
 
     async def get_fleet(self, req: Request):
         """Fleet placement/liveness/autoscaler status — served by the
-        process hosting the FleetController (the broker-side runtime)."""
+        process hosting the FleetController (the broker-side runtime).
+        Includes the broker's own stats (`EventBus.stats()`) when the
+        bus is local: per-topic depth, per-group lag + membership,
+        fence rejections, members evicted."""
         fleet = getattr(self.runtime, "fleet", None)
         if fleet is None:
             raise HttpError(404, "no fleet controller in this process")
-        return fleet.snapshot()
+        snap = fleet.snapshot()
+        stats_fn = getattr(self.runtime.bus, "stats", None)
+        broker = stats_fn() if callable(stats_fn) else None
+        snap["broker"] = broker if isinstance(broker, dict) else None
+        return snap
+
+    def _fleet_observer(self):
+        observer = getattr(self.runtime, "fleet_observer", None)
+        if observer is None:
+            raise HttpError(404, "no fleet observer in this process "
+                            "(runs beside the FleetController)")
+        return observer
+
+    async def get_fleet_observe(self, req: Request):
+        """The fleet-wide flight recorder (fleet/observer.py): merged
+        critical path, per-worker beats, per-tenant lag matrix, mesh
+        occupancy, broker stats, history-tier counts."""
+        return self._fleet_observer().snapshot()
+
+    async def get_fleet_prometheus(self, req: Request):
+        """Fleet-merged Prometheus exposition: per-worker/per-tenant
+        labeled gauges + merged critical-path quantiles."""
+        return ("text/plain; version=0.0.4",
+                self._fleet_observer().prometheus_text().encode())
+
+    async def get_history(self, req: Request):
+        """Durable telemetry history readback (persistence/durable.py
+        TelemetryHistory): `?tenant=&signal=` reads one series'
+        windowed rows (filtered by `since`/`until` on window start,
+        bounded by `limit`); without params, the available series and
+        store stats."""
+        history = getattr(self.runtime, "history", None)
+        if history is None:
+            raise HttpError(404, "no telemetry history in this process "
+                            "(needs data_dir + observe_history)")
+        tenant, signal = req.qp("tenant"), req.qp("signal")
+        if tenant is None or signal is None:
+            return {"series": [list(s) for s in history.series()],
+                    "stats": history.stats()}
+        until = req.float_qp("until", float("inf"))
+        rows = history.history(
+            tenant, signal,
+            since=req.float_qp("since", 0.0),
+            until=None if until == float("inf") else until,
+            limit=req.int_qp("limit", -1))
+        return {"tenant": tenant, "signal": signal,
+                "window_s": history.window_s, "rows": rows}
 
     async def get_trace_summary(self, req: Request):
         return self.runtime.tracer.stage_summary(tenant=req.qp("tenant"))
