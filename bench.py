@@ -636,6 +636,7 @@ async def run_fleet_bench(args) -> dict:
     # on the driver's registry.
     bus = EventBus(default_partitions=4, retention=65536)
     fleet_observe_on = not args.no_fleet_observe
+    wire_fast = not args.no_wire_fastpath
     rt = ServiceRuntime(InstanceSettings(
         instance_id="fleet-bench", bus_retention=65536,
         engine_ready_timeout_s=args.ready_timeout,
@@ -686,6 +687,10 @@ async def run_fleet_bench(args) -> dict:
                 # stays on — that's the `observe` preset's lever)
                 "observe_export": fleet_observe_on,
                 "observe_history": fleet_observe_on,
+                # wire fast-path A/B lever (the `wire` preset): off =
+                # request/response poll + task-per-produce_nowait
+                "wire_prefetch": wire_fast,
+                "wire_pipeline": wire_fast,
                 # worker-LOCAL scratch (registry WAL + snapshots), one
                 # private dir per worker — NOT a shared mount: adoption
                 # state comes from bus replay (hermetic fleet)
@@ -948,6 +953,23 @@ async def run_fleet_bench(args) -> dict:
         rate = best["rate"]
         rate_median = statistics.median(t["rate"] for t in clean)
 
+        # STEADY-STATE critical-path snapshot, taken BEFORE the kill
+        # drill: the drill's reconvergence backlog (records appended
+        # while the adopter pays jax engine start, ~15s) floods every
+        # stage's p99 with multi-second catch-up spans — real, but a
+        # reactive-scaling cost (ROADMAP item 2), not a steady wire/
+        # pipeline cost. The wire A/B's p99 acceptance reads THIS
+        # block; the end-of-run observe block (drill included) stays
+        # beside it for the honest full picture.
+        observe_steady = None
+        if controller.observer is not None:
+            cp = controller.observer.snapshot()["critical_path"]
+            observe_steady = {
+                "queue_wait_p99_ms": cp["queue_wait_p99_ms"],
+                "service_p99_ms": cp["service_p99_ms"],
+                "critical_path": cp["stages"],
+            }
+
         # ---- phase 2: scripted worker-kill drill ----
         kill_stats = None
         if n_workers >= 2 and not args.no_fleet_kill:
@@ -1139,6 +1161,7 @@ async def run_fleet_bench(args) -> dict:
             "fleet": {
                 "workers": n_workers,
                 "tenants": n_tenants,
+                "wire_fastpath": wire_fast,
                 "aggregate_sat": round(rate, 1),
                 "aggregate_sat_median": round(rate_median, 1),
                 "rebalances": int(controller.rebalances),
@@ -1151,6 +1174,7 @@ async def run_fleet_bench(args) -> dict:
                                            else 0),
                 "autoscaler_decisions": controller.decisions[-8:],
                 "observe": fleet_observe,
+                "observe_steady": observe_steady,
             },
             "saturation_trials": trials,
             "model": args.model,
@@ -2145,6 +2169,14 @@ def main() -> None:
                              "history tier) — the fleetobs A/B's off "
                              "leg; the per-process flight recorder "
                              "stays on (that lever is --no-observe)")
+    parser.add_argument("--no-wire-fastpath", action="store_true",
+                        help="--workers mode: disable the wire "
+                             "data-plane fast path in the workers "
+                             "(streaming poll prefetch + pipelined "
+                             "micro-batched produce, kernel/wire.py) — "
+                             "the ab_compare `wire` preset's off leg "
+                             "restores the PR-8 request/response "
+                             "broker plane")
     parser.add_argument("--zombie-drill", action="store_true",
                         help="--workers mode: SIGSTOP the busiest worker "
                              "past dead_after (false-positive death), "

@@ -41,6 +41,15 @@ Presets (the levers bench.py exposes):
               both legs, the plane's overhead A/B (acceptance:
               saturation within 3%); the extra table reports the on
               leg's fleet critical path + history counts
+    wire      on = `--workers N` (wire data-plane fast path:
+              streaming poll prefetch + pipelined micro-batched
+              produce + zero-copy codec, kernel/wire.py), off =
+              `--workers N --no-wire-fastpath` (the PR-8
+              request/response broker plane) — SAME worker count
+              both legs. The extra table reads each leg's fleet
+              critical path for the broker-hop stages (acceptance:
+              `wire.poll` p99 ≥ 5× lower on the on leg, saturation
+              median no worse, kill drill 0 lost on both legs)
 
 Usage:
 
@@ -128,6 +137,48 @@ def fleet_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
                     f"reconverged {kill.get('converged_after_kill_s')}s, "
                     f"replacement={kill.get('replacement_spawned')}", ""))
     out = [f"| metric | {name_b} | {name_a} | Δ (A vs B) |",
+           "|---|---|---|---|"]
+    out += [f"| {m} | {vb} | {va} | {d} |" for m, vb, va, d in rows]
+    return "\n".join(out)
+
+
+def wire_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
+    """Wire-preset extra table: the broker-hop stages of each leg's
+    fleet-merged critical path (the PR-11 instrument), plus the fleet
+    queue/service split — the acceptance read is `wire.poll` p99 off ÷
+    on ≥ 5 with saturation median no worse and 0 lost on both legs.
+    Reads the STEADY-STATE snapshot (pre-kill-drill) when present: the
+    drill's reconvergence backlog floods every p99 with multi-second
+    catch-up spans in both legs and would drown the hop signal."""
+    def obs(art):
+        fleet = art.get("fleet") or {}
+        return fleet.get("observe_steady") or fleet.get("observe") or {}
+
+    def hop(art, stage, q):
+        return ((obs(art).get("critical_path") or {}).get(stage) or {}) \
+            .get(q, 0.0)
+
+    rows = []
+    for stage in ("wire.poll", "wire.produce"):
+        pb, pa = hop(b, stage, "p99_ms"), hop(a, stage, "p99_ms")
+        rows.append((f"fleet `{stage}` p50 / p99 ms",
+                     f"{hop(b, stage, 'p50_ms')} / {pb}",
+                     f"{hop(a, stage, 'p50_ms')} / {pa}",
+                     f"{pb / pa:.1f}× lower" if pa else "—"))
+    rows.append(("fleet queue-wait p99 (ms)",
+                 f"{obs(b).get('queue_wait_p99_ms')}",
+                 f"{obs(a).get('queue_wait_p99_ms')}", ""))
+    rows.append(("fleet service p99 (ms)",
+                 f"{obs(b).get('service_p99_ms')}",
+                 f"{obs(a).get('service_p99_ms')}", ""))
+    for name, art in ((name_b, b), (name_a, a)):
+        kill = (art.get("fleet") or {}).get("kill") or {}
+        if kill:
+            rows.append((
+                f"kill drill lost ({name})",
+                "", f"{kill.get('lost_accepted_events')} of "
+                    f"{kill.get('accepted_events')} accepted", ""))
+    out = [f"| wire fast path | {name_b} | {name_a} | Δ |",
            "|---|---|---|---|"]
     out += [f"| {m} | {vb} | {va} | {d} |" for m, vb, va, d in rows]
     return "\n".join(out)
@@ -223,7 +274,8 @@ def main() -> int:
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
                                            "megabatch", "observe",
-                                           "fleet", "mesh", "fleetobs"])
+                                           "fleet", "mesh", "fleetobs",
+                                           "wire"])
     parser.add_argument("--mesh-shape", default="1x8",
                         help="DxM mesh for the mesh preset's on leg "
                              "(forced host-platform devices on CPU "
@@ -298,6 +350,17 @@ def main() -> int:
         pairs = [("off", ["--workers", w, "--no-fleet-observe"]),
                  ("on", ["--workers", w])]
         names = (f"fleet-observe off (w={w})", f"fleet-observe on (w={w})")
+    elif args.preset == "wire":
+        # SAME worker count both legs; the variable is the wire
+        # data-plane fast path (kernel/wire.py: streaming poll
+        # prefetch + pipelined micro-batched produce + zero-copy
+        # codec). The fleet observability plane stays ON in both legs
+        # — its merged critical path is the instrument that measures
+        # the broker-hop stages this preset exists to compare.
+        w = str(args.workers)
+        pairs = [("off", ["--workers", w, "--no-wire-fastpath"]),
+                 ("on", ["--workers", w])]
+        names = (f"wire fast path off (w={w})", f"wire fast path on (w={w})")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",
@@ -317,6 +380,10 @@ def main() -> int:
     b, a = artifacts  # baseline ran first (off / lanes1 / w1)
     if args.preset == "fleet":
         print(fleet_delta_table(names[1], a, names[0], b))
+    elif args.preset == "wire":
+        print(fleet_delta_table(names[1], a, names[0], b))
+        print()
+        print(wire_delta_table(names[1], a, names[0], b))
     elif args.preset == "fleetobs":
         print(fleet_delta_table(names[1], a, names[0], b))
         obs = (a.get("fleet") or {}).get("observe") or {}

@@ -56,6 +56,71 @@ np.asarray(ring.update_and_score(model, stack.stacked, dev, val))
 print("mesh smoke: OK (8-device {data:4, model:2} stacked dispatch)")
 PY
 
+# wire fast-path smoke (docs/PERFORMANCE.md wire fast path): one REAL
+# 2-process poll/produce round in streaming-prefetch mode — a broker
+# process (BusServer) and a consumer OS process (RemoteEventBus,
+# prefetch + pipelined produce on) exchange records over a socket; the
+# consumer must receive every record via pushed deliver frames (zero
+# poll RPCs), commit, and ack back through the coalesced produce path.
+env JAX_PLATFORMS=cpu python - <<'PY' || { echo "wire smoke: FAILED (prefetch data plane broken across processes)"; exit 1; }
+import asyncio, os, subprocess, sys
+
+CONSUMER = r'''
+import asyncio, sys
+sys.path.insert(0, ".")
+
+async def main():
+    from sitewhere_tpu.kernel.wire import RemoteEventBus
+    remote = RemoteEventBus("127.0.0.1", int(sys.argv[1]),
+                            prefetch=True, prefetch_credit=16)
+    await remote.initialize()
+    orig_call = remote._client.call  # spy: no poll RPCs may be issued
+    issued = []
+    async def spying_call(op, *a, **kw):
+        issued.append(op)
+        return await orig_call(op, *a, **kw)
+    remote._client.call = spying_call
+    consumer = remote.subscribe("smoke", group="g")
+    got = []
+    while len(got) < 20:
+        got += [r.value["i"] for r in await consumer.poll(
+            max_records=8, timeout=5.0)]
+    assert sorted(got) == list(range(20)), got
+    assert "poll" not in issued, f"prefetch mode issued poll RPCs: {issued}"
+    consumer.commit()
+    remote.produce_nowait("smoke-ack", {"ok": True, "n": len(got)})
+    await remote.stop()  # flushes the coalesced batch before close
+    print("CONSUMER-OK", flush=True)
+
+asyncio.run(main())
+'''
+
+async def main():
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.wire import BusServer
+    bus = EventBus(default_partitions=2)
+    server = BusServer(bus)
+    await server.start()
+    for i in range(20):
+        await bus.produce("smoke", {"i": i}, key=f"k{i % 4}")
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-c", CONSUMER, str(server.port),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out, err = await asyncio.wait_for(proc.communicate(), 120.0)
+    assert proc.returncode == 0, err.decode()[-2000:]
+    assert b"CONSUMER-OK" in out
+    ack = bus.peek("smoke-ack", limit=10)
+    assert ack and ack[-1].value == {"ok": True, "n": 20}, ack
+    committed = bus._groups["g"].committed
+    assert sum(committed.values()) == 20, committed
+    await server.stop()
+    print("wire smoke: OK (2-process prefetch round, 0 poll RPCs, "
+          "batched ack)")
+
+asyncio.run(main())
+PY
+
 # fleet-observe smoke (docs/OBSERVABILITY.md fleet observability): a
 # 2-worker trace must stitch end-to-end — ONE origin-scoped trace id
 # whose spine (receive → wire hop → enrich → persist → dispatch →

@@ -60,6 +60,10 @@ TRACE_STAGES: tuple[tuple[str, str], ...] = (
     # (queue). Recorded client-side on each side of the socket, so a
     # cross-process trace's queue-vs-service split covers the hop that
     # used to be dark (docs/OBSERVABILITY.md fleet observability).
+    # Under streaming prefetch (the default), wire.poll measures broker
+    # append → CREDIT DELIVERY (the deliver frame's arrival at the
+    # consumer process), not the poll RPC round trip — prefetch-buffer
+    # residency belongs to the consuming process's own stages.
     ("wire.produce", "service"),             # produce RPC → broker append
     ("wire.poll", "queue"),                  # broker append → delivery
     ("inbound.enrich", "service"),           # mask validate + split
@@ -161,6 +165,10 @@ COUNTERS = (
     "observe.exports",
     "observe.fleet_records",
     "observe.history_windows",
+    # wire data-plane fast path (kernel/wire.py): fire-and-forget ops
+    # that rode a coalesced multi-op batch frame (per-tick pipelined
+    # produce/commit — docs/PERFORMANCE.md wire fast path)
+    "wire.frames_coalesced",
 )
 
 GAUGES = (
@@ -192,6 +200,11 @@ GAUGES = (
     # live beat on the telemetry topic, observer's own topic lag
     "observe.fleet_workers",
     "observe.telemetry_lag",
+    # wire data-plane fast path (kernel/wire.py RemoteEventBus): the
+    # live credit window (0 = prefetch off) and the op count of the
+    # most recent coalesced batch frame
+    "wire.prefetch_credit",
+    "wire.linger_batches",
 )
 
 METERS = (

@@ -177,6 +177,22 @@ class InstanceSettings:
     fleet_heartbeat_s: float = 1.0
     fleet_dead_after_s: float = 5.0
     fleet_interval_s: float = 0.5      # controller tick / poll cadence
+    # wire data-plane fast path (kernel/wire.py, docs/PERFORMANCE.md):
+    # `wire_prefetch` streams record batches broker→consumer under a
+    # credit window of `wire_prefetch_credit` records (poll() drains a
+    # local buffer — no RPC round trip per consumer round);
+    # `wire_pipeline` coalesces fire-and-forget produce/commit frames
+    # per event-loop tick into one multi-op batch with one drain
+    # (`wire_linger_ms` > 0 widens the window Kafka-style; 0 batches
+    # only what is already queued); `wire_inflight_cap` bounds un-acked
+    # fire-and-forget ops — past it the client reports `backlogged`
+    # and consumer loops pause through the egress commit barrier.
+    # All on by default; bench `--no-wire-fastpath` is the A/B off leg.
+    wire_prefetch: bool = True
+    wire_prefetch_credit: int = 256
+    wire_pipeline: bool = True
+    wire_linger_ms: float = 0.0
+    wire_inflight_cap: int = 256
     # replicated tenant state (services/replication.py): publish the
     # device-registry mutation stream + interleaved snapshots on the
     # per-tenant registry-state topic, so an adopting worker rebuilds

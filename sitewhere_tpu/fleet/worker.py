@@ -183,6 +183,17 @@ class FleetWorker(LifecycleComponent):
                 s.get("pending", 0) for s in scoring.values())
             out["scoring_inflight"] = sum(
                 s.get("inflight", 0) for s in scoring.values())
+        bus = self.runtime.bus
+        if hasattr(bus, "wire_stats"):
+            # wire fast-path surface (kernel/wire.py): the client-side
+            # fire-and-forget window + coalescing counters ride every
+            # heartbeat, so the controller (and `swx fleet status`) see
+            # a worker throttled by broker backpressure as such rather
+            # than as a mysteriously lagging one
+            ws = bus.wire_stats()
+            out["wire_ff_pending"] = ws["ff_pending"]
+            out["wire_backlogged"] = ws["backlogged"]
+        if sample is not None:
             mesh = sample.get("mesh") or []
             if mesh:
                 # per-device mesh telemetry (scoring/pool.py

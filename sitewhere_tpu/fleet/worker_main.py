@@ -55,8 +55,16 @@ def build_runtime(cfg: dict):
     settings = InstanceSettings(
         instance_id=cfg["instance_id"], fleet_managed=True,
         **(cfg.get("settings") or {}))
+    # wire data-plane fast path (docs/PERFORMANCE.md): prefetch +
+    # pipelined produce ride the same settings overlay as every other
+    # knob, so the bench's A/B off leg is one `settings` key away
     bus = RemoteEventBus(cfg.get("host", "127.0.0.1"), cfg["port"],
-                         secret=cfg.get("secret"))
+                         secret=cfg.get("secret"),
+                         prefetch=settings.wire_prefetch,
+                         prefetch_credit=settings.wire_prefetch_credit,
+                         pipeline=settings.wire_pipeline,
+                         linger_ms=settings.wire_linger_ms,
+                         inflight_cap=settings.wire_inflight_cap)
     # owner-tag every membership this worker registers: a controller
     # death declaration then evicts them broker-side, so a SIGSTOPped
     # zombie's partitions reassign instead of stalling until SIGCONT

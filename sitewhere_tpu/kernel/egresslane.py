@@ -323,8 +323,13 @@ class EgressStage:
         # active lanes, not built shards: an auto-tuned stage's idle
         # ceiling shards can't drain anything, so they must not widen
         # the backpressure bound either
-        return self.backlog >= self.MAX_BACKLOG_PER_SHARD * max(self.active,
-                                                                1)
+        if self.backlog >= self.MAX_BACKLOG_PER_SHARD * max(self.active, 1):
+            return True
+        # wire bus fire-and-forget window full (kernel/wire.py): a
+        # stalled broker must pause the consumer loops through this
+        # same barrier instead of growing an unbounded op queue (or,
+        # pre-fast-path, an unbounded task set) client-side
+        return bool(getattr(self.engine.runtime.bus, "backlogged", False))
 
     @property
     def idle(self) -> bool:

@@ -112,6 +112,67 @@ def fastlane_enabled(tenant, runtime) -> bool:
     return True
 
 
+def _swallow_result(task: asyncio.Task) -> None:
+    if not task.cancelled():
+        task.exception()  # retrieve: a late failure is only log-worthy
+
+
+async def produce_settled(bus, topic, value, *, key=None, fence=None,
+                          mark=None) -> None:
+    """A produce whose CANCELLATION is unambiguous for commit
+    accounting — the third shared lane contract.
+
+    A consumer loop that publishes per-record output and commits
+    handled-through offsets has a classic window: a cancellation
+    (tenant release, engine stop) landing inside the produce await —
+    which on a wire bus is every produce — makes "was it published?"
+    unknowable: commit the record and a never-sent publish is LOST;
+    don't and a clean handoff re-publishes it through the adopter
+    (measured: the wire straddle drill double-scored exactly the batch
+    in flight at the release). This helper closes the window: the
+    produce runs as a shielded task carrying a SENT probe. The in-proc
+    append is synchronous (the probe flips with the append itself);
+    the wire client flips it the moment the frame is ON THE SOCKET — a
+    written frame on a live connection will be processed by the broker
+    regardless of this caller's fate — and a cancellation landing
+    while the frame is still queued client-side WITHDRAWS it
+    (WireClient.call), so the op observably never happened. On
+    cancellation: probe set → the record is on the broker's path,
+    `mark()` runs (count it handled — its offset may commit) and the
+    shielded task settles in the background; probe unset → the task is
+    cancelled and the publish provably never left this process, so
+    nothing marks and the adopter redelivers. A FencedError or publish
+    failure travels to the caller exactly like a bare produce."""
+    sent: list = []
+    remote = hasattr(bus, "wire_stats")  # RemoteEventBus: real probe
+
+    # flow admission and the enrich span are the CALLER's obligations
+    # (both lanes consult/record before reaching this publish — same
+    # rationale as validate_and_split's disables); this helper only
+    # changes the publish's cancellation accounting
+    async def run():  # swxlint: disable=FLW01,TRC01
+        if remote:
+            return await bus.produce(topic, value, key=key, fence=fence,
+                                     _sent=sent)
+        # in-proc: the append IS this first synchronous step
+        sent.append(True)
+        return await bus.produce(topic, value, key=key, fence=fence)
+
+    task = asyncio.ensure_future(run())
+    try:
+        await asyncio.shield(task)
+    except asyncio.CancelledError:
+        if sent:
+            if mark is not None:
+                mark()
+            task.add_done_callback(_swallow_result)
+        else:
+            # not on the wire yet: cancelling the task makes call()
+            # withdraw a still-queued frame — unpublished for certain
+            task.cancel()
+        raise
+
+
 async def checkpoint_commit(consumer, sink,
                             ckpt: Optional[tuple[int, dict]],
                             fence=None) -> Optional[tuple[int, dict]]:

@@ -388,6 +388,11 @@ class ServiceRuntime(LifecycleComponent):
             # it, leave its lifecycle to the owning runtime
         else:
             self._external_bus = self.bus
+            if hasattr(self.bus, "metrics"):
+                # wire bus: the fast path's gauges/counters
+                # (wire.prefetch_credit / linger_batches /
+                # frames_coalesced) land on this runtime's registry
+                self.bus.metrics = self.metrics
         # epoch fencing, worker side (docs/FLEET.md): the ledger of
         # (tenant, epoch) grants this process holds. FleetWorker sets
         # worker_id/on_lost; non-fleet runtimes never grant, so every

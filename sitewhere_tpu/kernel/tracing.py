@@ -17,6 +17,14 @@ dispatch) or *service* (time spent working), so the critical-path
 report can answer "where does paced p99 live" with a queue-wait vs
 service-time split.
 
+Wire-hop spans (kernel/wire.py) keep their meaning across transport
+modes: `wire.produce` is the append RPC's service time, `wire.poll` the
+broker-append→delivery queue wait — under streaming prefetch the
+delivery instant is the deliver frame's ARRIVAL (credit delivery), so
+the hop's queue wait never absorbs time records spend in the consumer's
+own prefetch buffer (that residency shows up downstream, where it
+belongs).
+
 Sampling keeps the hot path honest: at 1M events/s nobody can afford a
 span per batch per stage, so only every `sample`-th trace id records
 (trace ids are dense counters, so modulo sampling is uniform). Spans

@@ -8,8 +8,8 @@ process boundary when a deployment wants it, with the same two planes:
 
 - **BusServer / RemoteEventBus** — one process hosts the `EventBus`; any
   number of peer processes attach with the full consumer-group surface
-  (produce, subscribe, long-poll, commit, snapshot/positions, rebalance
-  on leave). Records cross the socket in the restricted codec
+  (produce, subscribe, poll, commit, snapshot/positions, rebalance on
+  leave). Records cross the socket in the restricted codec
   (kernel/codec.py) — columnar batches stay columnar.
 - **ApiServer / ApiChannel** — per-service control RPC: wait-for-engine
   (the reference's `waitForApiAvailable` retry) and method calls on a
@@ -19,11 +19,46 @@ process boundary when a deployment wants it, with the same two planes:
   remote method calls return awaitables (callers on potential remote
   paths guard with `inspect.isawaitable`).
 
+Wire fast path (docs/PERFORMANCE.md): three layers turn the broker hop
+from a request/response RPC benchmark into a streaming data plane —
+
+1. **Streaming poll prefetch.** Instead of one `poll` RPC per consumer
+   round (broker-side long-poll wait + a full client round trip per
+   batch), a subscribed consumer grants the broker a CREDIT window of
+   records; the broker pushes `deliver` frames (request id 0 = server
+   push) as records land, the client `poll()` drains a local prefetch
+   buffer, and drained records re-grant credit fire-and-forget. The
+   broker-append→consumer-delivery path collapses to one socket write.
+   Commit/fence/rebalance semantics are unchanged: the client-side
+   delivered-through pin still covers exactly what `poll()` handed the
+   app (never the prefetch buffer), fence tokens are validated
+   broker-side exactly as before, and a rebalance or seek REVOKES the
+   window — the broker emits a `revoke` push, the client drops its
+   undrained buffer, and the moved partition's records re-deliver from
+   committed offsets to whoever owns them now (no double delivery
+   beyond today's in-flight-batch at-least-once window).
+2. **Pipelined micro-batched produce.** Fire-and-forget ops
+   (produce_nowait / commit / credit / close) coalesce per event-loop
+   tick into ONE multi-op `batch` frame with one writev and one drain
+   (Kafka linger semantics, linger=0 default: batch only what is
+   already queued — nothing ever waits for company), replacing the old
+   task-spawn-per-op; acks ride one batched response, and a
+   FencedError inside the batch still fires `on_fenced` with the
+   rejected token's identity. Awaited calls ride the same per-tick
+   write queue (frames keep their enqueue order), so a commit enqueued
+   before a release record can never be overtaken by it.
+3. **Zero-copy codec path.** Frames are encoded as scatter-gather
+   segment lists (`codec.encode_segments`) — ndarray columns ride as
+   memoryviews over the live arrays, written via `writelines` — and
+   the rx loops decode with `copy_arrays=False`, so delivered batch
+   columns are read-only views over the received frame.
+
 Framing: u32 body length | u32 request id | codec body. Requests carry
 `{"op": ..., ...}`; responses `{"ok": result}` or `{"err": message}`.
-Request ids multiplex concurrent calls (long-polls don't block the
-connection). This plane is instance-internal — deploy it on the same
-trust boundary the reference gives its unauthenticated internal gRPC.
+Request ids multiplex concurrent calls; id 0 is reserved for
+server-initiated push frames (`deliver`/`revoke`). This plane is
+instance-internal — deploy it on the same trust boundary the reference
+gives its unauthenticated internal gRPC.
 """
 
 from __future__ import annotations
@@ -32,6 +67,7 @@ import asyncio
 import itertools
 import logging
 import time
+from collections import deque
 from typing import Any, Iterable, Optional
 
 from sitewhere_tpu.kernel import codec
@@ -39,7 +75,28 @@ from sitewhere_tpu.kernel.bus import EventBus, FencedError, TopicRecord
 
 logger = logging.getLogger(__name__)
 
-_MAX_FRAME = 256 * 1024 * 1024
+_MAX_FRAME = codec.MAX_FRAME
+
+# client-side fast-path defaults (InstanceSettings.wire_* overrides)
+DEFAULT_PREFETCH_CREDIT = 256     # records the broker may push ahead
+DEFAULT_INFLIGHT_CAP = 256        # un-acked fire-and-forget ops
+_PUSH_BATCH_MAX = 256             # records per deliver frame
+_DRAIN_WATERMARK = 1 << 19        # spawn a drain task past this buffer
+
+# marker object: a fire-and-forget batch's position in the write queue
+# (the frame itself is assembled at flush time, but its ORDER relative
+# to awaited frames is fixed at first enqueue — a commit enqueued
+# before a release publish must reach the broker first)
+_BATCH_MARK = object()
+
+
+def _frame(req_id: int, msg: Any) -> list:
+    """One wire frame as a scatter-gather buffer list."""
+    segs, total = codec.encode_segments(msg)
+    if total > _MAX_FRAME:
+        raise ValueError(f"frame {total} exceeds max")
+    return [total.to_bytes(4, "little") + req_id.to_bytes(4, "little"),
+            *segs]
 
 
 class WireServer:
@@ -101,11 +158,9 @@ class WireServer:
                       and hmac.compare_digest(msg["token"], self.secret))
             except Exception:  # noqa: BLE001 - any garbage is a failed auth
                 ok = False
-        payload = codec.encode(
-            {"ok": True} if ok else {"err": "PermissionError: wire auth "
-                                            "failed"})
-        writer.write(len(payload).to_bytes(4, "little")
-                     + req_id.to_bytes(4, "little") + payload)
+        writer.writelines(_frame(
+            req_id, {"ok": True} if ok else
+            {"err": "PermissionError: wire auth failed"}))
         await writer.drain()
         return ok
 
@@ -142,50 +197,112 @@ class WireServer:
     async def _dispatch(self, req_id: int, body: bytes,
                         writer: asyncio.StreamWriter) -> None:
         try:
-            msg = codec.decode(body)
+            # requests are small control frames; values inside a produce
+            # decode zero-copy and the broker log then holds views over
+            # this body — the frame buffer lives exactly as long as the
+            # arrays referencing it
+            msg = codec.decode(body, copy_arrays=False)
             handler = self.handlers[msg["op"]]
             result = await handler(msg, writer)
-            payload = codec.encode({"ok": result})
+            payload = _frame(req_id, {"ok": result})
         except asyncio.CancelledError:
             raise
         except Exception as exc:  # noqa: BLE001 - errors travel to the caller
-            payload = codec.encode(
-                {"err": f"{type(exc).__name__}: {exc}"})
+            payload = _frame(req_id, {"err": f"{type(exc).__name__}: {exc}"})
         try:
-            writer.write(len(payload).to_bytes(4, "little")
-                         + req_id.to_bytes(4, "little") + payload)
+            writer.writelines(payload)
             await writer.drain()
         except (ConnectionError, RuntimeError):
             pass  # peer went away mid-response
 
+    async def _op_batch(self, msg, writer=None) -> list:
+        """One multi-op frame (the client's per-tick coalesced
+        fire-and-forget batch): ops execute IN ORDER, each isolated —
+        per-op results/errors ride one batched response."""
+        out = []
+        for op in msg["ops"]:
+            try:
+                name = op["op"]
+                if name == "batch":
+                    raise ValueError("nested batch refused")
+                out.append({"ok": await self.handlers[name](op, writer)})
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - per-op isolation
+                out.append({"err": f"{type(exc).__name__}: {exc}"})
+        return out
+
 
 class WireClient:
     """Multiplexed request/response client (one connection, many
-    outstanding calls — long-polls don't serialize)."""
+    outstanding calls — long-polls don't serialize).
 
-    def __init__(self, host: str, port: int, secret: Optional[str] = None):
+    With `pipeline=True` (default) every outgoing frame rides a
+    per-event-loop-tick write queue: frames enqueued during one tick go
+    out in ONE `writelines` with at most one drain, and fire-and-forget
+    ops additionally coalesce into one multi-op `batch` frame (one
+    request id, one batched ack). `linger_ms` > 0 widens the window
+    Kafka-producer style; 0 (default) batches only what is already
+    queued. `inflight_cap` bounds un-acked fire-and-forget ops: past
+    it, further ops stay queued client-side and `backlogged` turns on —
+    the signal the egress commit barrier surfaces so consumer loops
+    pause instead of growing an unbounded op queue against a stalled
+    broker (the old task-per-op spawn grew the task set without
+    limit)."""
+
+    def __init__(self, host: str, port: int, secret: Optional[str] = None,
+                 *, pipeline: bool = True, linger_ms: float = 0.0,
+                 inflight_cap: int = DEFAULT_INFLIGHT_CAP):
         self.host, self.port = host, port
         self.secret = secret
+        self.pipeline = pipeline
+        self.linger_ms = max(float(linger_ms), 0.0)
+        self.inflight_cap = max(int(inflight_cap), 1)
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
         self._req_ids = itertools.count(1)
         self._rx_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+        self._dead = False   # kill(): crash fidelity — no reconnects
         # fire-and-forget RPCs (commit/close/produce_nowait) park here so
         # they are neither GC'd mid-flight nor silently raced by close();
         # `flush_background()` awaits them at orderly shutdown
         self._bg: set[asyncio.Task] = set()
         # fencing notification for fire-and-forget paths: a background
         # commit rejected with FencedError cannot raise into the caller,
-        # so the runtime registers a callback(tenant) here instead
+        # so the runtime registers a callback(tenant, epoch) here instead
         # (ServiceRuntime wires it to FenceState.mark_fenced)
         self.on_fenced = None
+        # pipelined write queue: frames (buffer lists) + batch marks
+        self._wq: list = []
+        self._mark_queued = False
+        self._ff_ops: list[dict] = []   # queued fire-and-forget ops
+        self._ff_inflight = 0           # written, awaiting the batch ack
+        self._flush_scheduled = False
+        self._drain_task: Optional[asyncio.Task] = None
+        # server-push routing (prefetch): cid -> handler(msg). Pushes
+        # for a cid whose subscribe response hasn't landed yet park in
+        # _orphan_pushes until the consumer registers; pushes for a
+        # cid the client already closed are dropped (the broker's
+        # close_consumer is in flight — parking them would leak a
+        # credit window per closed consumer).
+        self._push_handlers: dict[int, Any] = {}
+        self._orphan_pushes: dict[int, list] = {}
+        self._closed_cids: set[int] = set()
+        # observability hooks (RemoteEventBus wires the registry)
+        self.coalesce_counter = None    # wire.frames_coalesced
+        self.coalesce_gauge = None      # wire.linger_batches
+        self.frames_coalesced_total = 0
+
+    # -- connection ---------------------------------------------------------
 
     async def connect(self, timeout: float = 10.0,
                       retry_interval: float = 0.2) -> None:
         """Connect with wait-for-available retry (the peer may still be
         starting — reference: ApiChannel.waitForApiAvailable)."""
+        if self._dead:
+            raise ConnectionError("wire client killed")
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
             try:
@@ -199,8 +316,9 @@ class WireClient:
         self._rx_task = asyncio.create_task(self._rx_loop(),
                                             name=f"wire-rx-{self.port}")
         if self.secret is not None:
-            # must be the connection's first frame (server handshake)
-            await self.call("auth", token=self.secret)
+            # must be the connection's first frame: bypass the write
+            # queue (a queued fire-and-forget batch must not precede it)
+            await self.call("auth", _immediate=True, token=self.secret)
 
     async def _rx_loop(self) -> None:
         try:
@@ -209,6 +327,16 @@ class WireClient:
                 length = int.from_bytes(header[:4], "little")
                 req_id = int.from_bytes(header[4:], "little")
                 body = await self._reader.readexactly(length)
+                if req_id == 0:
+                    # server push (prefetch deliver/revoke): decode here
+                    # — zero-copy, the delivered columns are views over
+                    # this body — and route to the consumer's buffer
+                    try:
+                        self._dispatch_push(
+                            codec.decode(body, copy_arrays=False))
+                    except Exception:  # noqa: BLE001 - a bad push is logged
+                        logger.exception("wire: bad push frame")
+                    continue
                 fut = self._pending.pop(req_id, None)
                 if fut is not None and not fut.done():
                     fut.set_result(body)
@@ -218,7 +346,223 @@ class WireClient:
                     fut.set_exception(ConnectionError("wire peer closed"))
             self._pending.clear()
 
-    async def call(self, op: str, **kwargs: Any) -> Any:
+    def _dispatch_push(self, msg: dict) -> None:
+        cid = msg.get("cid")
+        handler = self._push_handlers.get(cid)
+        if handler is not None:
+            handler(msg)
+            return
+        if cid in self._closed_cids:
+            return  # consumer closed; broker-side reap is in flight
+        # subscribe response still in flight: park (bounded by the
+        # credit window the server enforces)
+        self._orphan_pushes.setdefault(cid, []).append(msg)
+
+    def register_push(self, cid: int, handler) -> None:
+        """Bind a consumer's push handler; drains any pushes that beat
+        the subscribe response across the socket."""
+        self._push_handlers[cid] = handler
+        for msg in self._orphan_pushes.pop(cid, ()):
+            handler(msg)
+
+    def unregister_push(self, cid: int) -> None:
+        self._push_handlers.pop(cid, None)
+        self._orphan_pushes.pop(cid, None)
+        self._closed_cids.add(cid)
+
+    # -- pipelined writes ---------------------------------------------------
+
+    def _schedule_flush(self) -> None:
+        if self._flush_scheduled:
+            return
+        self._flush_scheduled = True
+        loop = asyncio.get_running_loop()
+        if self.linger_ms > 0:
+            loop.call_later(self.linger_ms / 1e3, self._do_flush)
+        else:
+            # linger=0: the callback runs next loop iteration, so
+            # everything enqueued during THIS tick coalesces
+            loop.call_soon(self._do_flush)
+
+    def _do_flush(self) -> None:
+        self._flush_scheduled = False
+        if self._dead:
+            self._wq.clear()
+            self._ff_ops.clear()
+            self._mark_queued = False
+            return
+        if self._writer is None:
+            if self._wq or self._ff_ops:
+                self.spawn(self._connect_then_flush())
+            return
+        out: list = []
+        rest: Optional[list] = None
+        for i, item in enumerate(self._wq):
+            if item is _BATCH_MARK:
+                budget = self.inflight_cap - self._ff_inflight
+                if budget <= 0:
+                    # capped: this batch AND every later frame hold, so
+                    # a commit can never be overtaken by a release
+                    rest = self._wq[i:]
+                    break
+                ops = self._ff_ops[:budget]
+                del self._ff_ops[:len(ops)]
+                bufs, accepted = self._batch_frame(ops)
+                self._ff_inflight += accepted
+                out.extend(bufs)
+                if self._ff_ops:
+                    rest = self._wq[i:]  # keep the mark for the rest
+                    break
+                self._mark_queued = False
+            else:
+                out.extend(item)
+        self._wq = rest if rest is not None else []
+        if not out:
+            return
+        try:
+            self._writer.writelines(out)
+        except (ConnectionError, RuntimeError):
+            return  # rx loop / close() surface the failure to callers
+        transport = self._writer.transport
+        if (self._drain_task is None and transport is not None
+                and transport.get_write_buffer_size() > _DRAIN_WATERMARK):
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._drain_once())
+
+    async def _drain_once(self) -> None:
+        try:
+            if self._writer is not None:
+                await self._writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass
+        finally:
+            self._drain_task = None
+
+    async def _connect_then_flush(self) -> None:
+        try:
+            async with self._lock:
+                if self._writer is None:
+                    await self.connect()
+        except (OSError, ConnectionError):
+            dropped = len(self._ff_ops)
+            self._wq.clear()
+            self._ff_ops.clear()
+            self._mark_queued = False
+            if dropped:
+                logger.warning("wire: dropped %d queued fire-and-forget "
+                               "op(s) — broker unreachable", dropped)
+            return
+        self._schedule_flush()
+
+    def _batch_frame(self, ops: list[dict]) -> tuple[list, int]:
+        """Assemble the coalesced multi-op frame. Returns (buffers,
+        accepted op count) — the accounting future/task registers ONLY
+        for ops whose frame actually encoded, so one unencodable value
+        (or an oversize combined frame) can never leak in-flight budget
+        or orphan an ack waiter: the poison op is dropped loudly and
+        the rest ride per-op frames."""
+        try:
+            bufs = _frame(0, {"op": "batch", "ops": ops})
+        except Exception:  # noqa: BLE001 - isolate the poison op(s)
+            bufs = []
+            good: list[dict] = []
+            for op in ops:
+                try:
+                    bufs.extend(self._register_batch([op]))
+                except Exception:  # noqa: BLE001 - dropped, loudly
+                    logger.warning(
+                        "wire: dropped unencodable fire-and-forget "
+                        "%s op", op.get("op"), exc_info=True)
+                else:
+                    good.append(op)
+            return bufs, len(good)
+        # common case: one frame, one ack task, encoded before any
+        # accounting moved
+        return self._register_batch(ops, prebuilt=bufs), len(ops)
+
+    def _register_batch(self, ops: list[dict],
+                        prebuilt: Optional[list] = None) -> list:
+        bufs = prebuilt if prebuilt is not None \
+            else _frame(0, {"op": "batch", "ops": ops})
+        req_id = next(self._req_ids)
+        # stamp the real request id into the prebuilt header
+        bufs[0] = bufs[0][:4] + req_id.to_bytes(4, "little")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        self.spawn(self._finish_batch(fut, ops))
+        n = len(ops)
+        if n > 1:
+            self.frames_coalesced_total += n
+            if self.coalesce_counter is not None:
+                self.coalesce_counter.inc(n)
+        if self.coalesce_gauge is not None:
+            self.coalesce_gauge.set(n)
+        return bufs
+
+    async def _finish_batch(self, fut: asyncio.Future,
+                            ops: list[dict]) -> None:
+        """Process one batched ack: per-op errors resolve exactly like
+        the old task-per-op done callbacks (FencedError → on_fenced with
+        the rejected token's identity). Decrements clamp at zero:
+        close() already zeroes the in-flight count while these tasks
+        still hold their op batches, and a negative count would disable
+        the backpressure cap on a reconnected client."""
+        try:
+            body = await fut
+        except (ConnectionError, asyncio.CancelledError):
+            self._ff_inflight = max(self._ff_inflight - len(ops), 0)
+            raise
+        self._ff_inflight = max(self._ff_inflight - len(ops), 0)
+        try:
+            msg = codec.decode(body, copy_arrays=False)
+            results = msg["ok"] if "ok" in msg else []
+            if "err" in msg:
+                logger.debug("wire batch failed remotely: %s", msg["err"])
+            for op, res in zip(ops, results):
+                err = res.get("err") if isinstance(res, dict) else None
+                if err is None:
+                    continue
+                if str(err).startswith("FencedError:") \
+                        and self.on_fenced is not None:
+                    tok = op.get("fence") or [None, None]
+                    self.on_fenced(tok[0],
+                                   tok[1] if len(tok) > 1 else None)
+                else:
+                    logger.debug("wire batched %s failed: %s",
+                                 op.get("op"), err)
+        finally:
+            if self._ff_ops and not self._flush_scheduled:
+                # cap headroom just opened: move the queued remainder
+                self._schedule_flush()
+
+    # -- calls --------------------------------------------------------------
+
+    @property
+    def ff_pending(self) -> int:
+        """Fire-and-forget ops not yet acked (queued + in flight)."""
+        return len(self._ff_ops) + self._ff_inflight
+
+    @property
+    def backlogged(self) -> bool:
+        """Fire-and-forget backpressure: the op window is full (stalled
+        or slow broker). Producers with a commit barrier pause on this
+        instead of queueing without bound."""
+        return self.ff_pending >= self.inflight_cap
+
+    async def call(self, op: str, _immediate: bool = False,
+                   _sent: Optional[list] = None, **kwargs: Any) -> Any:
+        """One awaited RPC. `_sent` (optional, a mutable list) is the
+        publish-settlement probe `produce_settled` threads through
+        `RemoteEventBus.produce`: it becomes truthy the moment the
+        frame is ON THE SOCKET (a written frame on a live connection
+        will be processed by the broker even if this caller is
+        cancelled while awaiting the ack), and a cancellation that
+        lands while the frame is still queued (capped behind a
+        fire-and-forget batch) WITHDRAWS it — the op then observably
+        never happened. Cancellation is thereby unambiguous: probe set
+        → the broker will see the op; probe unset → it never will."""
+        if self._dead:
+            raise ConnectionError("wire client killed")
         if self._writer is None:
             async with self._lock:
                 if self._writer is None:
@@ -226,12 +570,41 @@ class WireClient:
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        payload = codec.encode({"op": op, **kwargs})
-        self._writer.write(len(payload).to_bytes(4, "little")
-                           + req_id.to_bytes(4, "little") + payload)
-        await self._writer.drain()
-        body = await fut
-        msg = codec.decode(body)
+        frame = _frame(req_id, {"op": op, **kwargs})
+        if self.pipeline and not _immediate:
+            # awaited calls flush NOW (an RPC must never wait out a
+            # long event-loop tick — measured: deferring these to the
+            # tick-end callback serialized the egress shard's awaited
+            # produces at one per tick and cost 17% fleet saturation),
+            # carrying any queued fire-and-forget batch ahead of them
+            # in enqueue order — a commit queued before a release
+            # publish still reaches the broker first
+            self._wq.append(frame)
+            self._do_flush()
+            if _sent is not None \
+                    and not any(f is frame for f in self._wq):
+                _sent.append(True)
+        else:
+            self._writer.writelines(frame)
+            if _sent is not None:
+                _sent.append(True)
+            await self._writer.drain()
+        try:
+            body = await fut
+        except asyncio.CancelledError:
+            if _sent is not None and not _sent:
+                # the frame never reached the socket (capped behind a
+                # stalled batch): withdraw it, unless a flush wrote it
+                # between the cap check and this cancellation
+                for i, f in enumerate(self._wq):
+                    if f is frame:
+                        del self._wq[i]
+                        self._pending.pop(req_id, None)
+                        break
+                else:
+                    _sent.append(True)  # flushed since: it WILL land
+            raise
+        msg = codec.decode(body, copy_arrays=False)
         if "err" in msg:
             if str(msg["err"]).startswith("FencedError:"):
                 # the broker rejected a stale-epoch data-path write:
@@ -244,8 +617,26 @@ class WireClient:
             raise RuntimeError(f"wire call {op} failed remotely: {msg['err']}")
         return msg["ok"]
 
+    def call_nowait(self, op: str, **kwargs: Any) -> None:
+        """Fire-and-forget op on the coalescing path: rides this tick's
+        multi-op batch frame. Never blocks; never spawns a task per op
+        (the pre-fast-path design did, and a stalled broker grew the
+        task set without limit — now the op queue is the only growth,
+        and `backlogged` gates it)."""
+        if self._dead:
+            return
+        if not self.pipeline:
+            # legacy path (the A/B off leg): one spawned RPC per op
+            self.spawn(self.call(op, **kwargs))
+            return
+        self._ff_ops.append({"op": op, **kwargs})
+        if not self._mark_queued:
+            self._wq.append(_BATCH_MARK)
+            self._mark_queued = True
+        self._schedule_flush()
+
     def spawn(self, coro) -> asyncio.Task:
-        """Run a fire-and-forget RPC, retained until done."""
+        """Run a fire-and-forget coroutine, retained until done."""
         task = asyncio.get_running_loop().create_task(coro)
         self._bg.add(task)
 
@@ -266,10 +657,21 @@ class WireClient:
         return task
 
     async def flush_background(self, timeout: float = 5.0) -> None:
-        """Let in-flight fire-and-forget RPCs (final commits, consumer
-        closes) land before the connection is torn down."""
+        """Let queued/in-flight fire-and-forget work (final commits,
+        consumer closes, the tick batch) land before teardown."""
+        deadline = time.monotonic() + timeout
+        while (self._ff_ops or self._ff_inflight) \
+                and time.monotonic() < deadline and not self._dead:
+            if self._ff_ops and not self._flush_scheduled:
+                try:
+                    self._schedule_flush()
+                except RuntimeError:
+                    break  # no running loop
+            await asyncio.sleep(0.005)
         if self._bg:
-            await asyncio.wait(list(self._bg), timeout=timeout)
+            await asyncio.wait(list(self._bg),
+                               timeout=max(deadline - time.monotonic(),
+                                           0.05))
 
     def close(self) -> None:
         # a caller may be parked inside call(): resolve its future with a
@@ -278,6 +680,19 @@ class WireClient:
             if not fut.done():
                 fut.set_exception(ConnectionError("wire client closed"))
         self._pending.clear()
+        dropped = len(self._ff_ops)
+        if dropped:
+            logger.debug("wire: %d queued fire-and-forget op(s) dropped "
+                         "at close", dropped)
+        self._wq.clear()
+        self._ff_ops.clear()
+        self._mark_queued = False
+        self._ff_inflight = 0
+        self._push_handlers.clear()
+        self._orphan_pushes.clear()
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            self._drain_task = None
         if self._rx_task is not None:
             self._rx_task.cancel()
             self._rx_task = None
@@ -288,10 +703,29 @@ class WireClient:
                 pass
             self._writer = None
 
+    def kill(self) -> None:
+        """Crash-fidelity close (tests, SIGKILL stand-ins): the
+        connection drops NOW, every later call raises ConnectionError,
+        and nothing reconnects — the broker sees exactly what a killed
+        process would leave behind."""
+        self._dead = True
+        self.close()
+
 
 # ---------------------------------------------------------------------------
 # data plane: the bus over the wire
 # ---------------------------------------------------------------------------
+
+
+class _PrefetchState:
+    """Broker-side credit window for one prefetching consumer."""
+
+    __slots__ = ("credit", "wake", "task")
+
+    def __init__(self, credit: int):
+        self.credit = int(credit)
+        self.wake = asyncio.Event()
+        self.task: Optional[asyncio.Task] = None
 
 
 class BusServer(WireServer):
@@ -304,11 +738,14 @@ class BusServer(WireServer):
         self._consumers: dict[int, Any] = {}
         self._by_conn: dict[asyncio.StreamWriter, set[int]] = {}
         self._cids = itertools.count(1)
+        self._prefetch: dict[int, _PrefetchState] = {}
         self.handlers = {
             "produce": self._op_produce,
             "subscribe": self._op_subscribe,
             "poll": self._op_poll,
             "commit": self._op_commit,
+            "credit": self._op_credit,
+            "batch": self._op_batch,
             "positions": self._op_positions,
             "seek_begin": self._op_seek_begin,
             "close_consumer": self._op_close,
@@ -335,13 +772,89 @@ class BusServer(WireServer):
         consumer = self.bus.subscribe(msg["topics"], group=msg["group"],
                                       name=msg.get("name"),
                                       owner=msg.get("owner"))
+        if msg.get("seek"):
+            # seek-from-beginning decided before the subscribe landed
+            # (replay consumers): apply it BEFORE any push delivery, so
+            # the stream starts at the beginning instead of mixing
+            # committed-position rows with replayed ones
+            consumer.seek_to_beginning()
         cid = next(self._cids)
         self._consumers[cid] = consumer
         if writer is not None:
             # bind the consumer to its connection: a dropped peer leaves
             # its groups (rebalance) instead of starving them
             self._by_conn.setdefault(writer, set()).add(cid)
+        credit = int(msg.get("prefetch") or 0)
+        if credit > 0 and writer is not None:
+            # streaming prefetch: the broker pushes deliver frames under
+            # the client's credit window instead of answering poll RPCs
+            st = _PrefetchState(credit)
+            self._prefetch[cid] = st
+            st.task = asyncio.get_running_loop().create_task(
+                self._push_loop(cid, consumer, writer, st),
+                name=f"wire-push-{cid}")
         return cid
+
+    def _push_frame(self, writer: asyncio.StreamWriter, msg: dict) -> None:
+        writer.writelines(_frame(0, msg))
+
+    async def _push_loop(self, cid: int, consumer, writer,
+                         st: _PrefetchState) -> None:
+        """Stream records to one prefetching consumer while it has
+        credit. The whole poll→frame-write step is atomic wrt the event
+        loop after the poll resolves, so a rebalance/seek either lands
+        before a delivery (its revoke precedes the re-fetched rows) or
+        after it (the revoke follows the stale rows) — the client drops
+        its undrained buffer on revoke either way, and the dropped rows
+        re-deliver from committed offsets."""
+        gen = getattr(consumer, "_generation", -1)
+        try:
+            while not getattr(consumer, "_closed", False):
+                if st.credit <= 0:
+                    st.wake.clear()
+                    if st.credit <= 0:
+                        try:
+                            await asyncio.wait_for(st.wake.wait(), 1.0)
+                        except asyncio.TimeoutError:
+                            pass  # re-check closed/credit
+                    continue
+                n = min(st.credit, _PUSH_BATCH_MAX)
+                records = await consumer.poll(max_records=n, timeout=0.5)
+                if records and len(records) < n:
+                    # scoop the same tick's remaining appends into this
+                    # frame: the wake fires on the FIRST append of a
+                    # burst, and one frame per record would pay encode +
+                    # header + rx-decode per record under flood (the
+                    # old poll RPC amortized a round trip's worth per
+                    # response; one yield buys the same batching)
+                    await asyncio.sleep(0)
+                    records += consumer.poll_nowait(n - len(records))
+                if consumer._generation != gen:
+                    # REVOKE before delivering post-rebalance rows: the
+                    # client's undrained window is stale (positions
+                    # reset to committed broker-side) — a moved
+                    # partition must not double-deliver through it
+                    gen = consumer._generation
+                    self._push_frame(writer, {"op": "revoke", "cid": cid,
+                                              "gen": gen})
+                if records:
+                    st.credit -= len(records)
+                    rows = [[r.topic, r.partition, r.offset, r.key,
+                             r.value, r.timestamp] for r in records]
+                    self._push_frame(writer, {"op": "deliver", "cid": cid,
+                                              "rows": rows})
+                    await writer.drain()
+        except (ConnectionError, ConnectionResetError, RuntimeError):
+            pass  # peer gone: on_disconnect reaps the consumer
+        except asyncio.CancelledError:
+            pass
+
+    async def _op_credit(self, msg, writer=None) -> bool:
+        st = self._prefetch.get(msg["cid"])
+        if st is not None:
+            st.credit += int(msg["n"])
+            st.wake.set()
+        return True
 
     async def _op_poll(self, msg, writer=None) -> list:
         consumer = self._consumers[msg["cid"]]
@@ -362,10 +875,25 @@ class BusServer(WireServer):
         return [[t, p, off] for (t, p), off in snap.items()]
 
     async def _op_seek_begin(self, msg, writer=None) -> bool:
-        self._consumers[msg["cid"]].seek_to_beginning()
+        cid = msg["cid"]
+        self._consumers[cid].seek_to_beginning()
+        st = self._prefetch.get(cid)
+        if st is not None and writer is not None:
+            # prefetch: anything already pushed (or queued on the
+            # socket) predates the seek — revoke so the client drops it
+            # and the stream restarts from the beginning
+            self._push_frame(writer, {"op": "revoke", "cid": cid,
+                                      "gen": -1})
+            st.wake.set()
         return True
 
+    def _reap_prefetch(self, cid: int) -> None:
+        st = self._prefetch.pop(cid, None)
+        if st is not None and st.task is not None:
+            st.task.cancel()
+
     async def _op_close(self, msg, writer=None) -> bool:
+        self._reap_prefetch(msg["cid"])
         consumer = self._consumers.pop(msg["cid"], None)
         if consumer is not None:
             consumer.close()
@@ -392,16 +920,27 @@ class BusServer(WireServer):
 
     def on_disconnect(self, writer: asyncio.StreamWriter) -> None:
         for cid in self._by_conn.pop(writer, ()):
+            self._reap_prefetch(cid)
             consumer = self._consumers.pop(cid, None)
             if consumer is not None:
                 consumer.close()
 
+    async def stop(self) -> None:
+        for cid in list(self._prefetch):
+            self._reap_prefetch(cid)
+        await super().stop()
+
 
 class RemoteBusConsumer:
-    """Client-side consumer handle; mirrors `BusConsumer`'s surface."""
+    """Client-side consumer handle; mirrors `BusConsumer`'s surface.
+
+    Two delivery modes share it: the legacy poll RPC (prefetch off) and
+    the streaming prefetch buffer (deliver frames land in `_buf` from
+    the rx loop; `poll()` drains it locally and re-grants credit)."""
 
     def __init__(self, client: WireClient, cid: int, group: str, name: str,
-                 tracer=None):
+                 tracer=None, prefetch: bool = False,
+                 prefetch_credit: int = DEFAULT_PREFETCH_CREDIT):
         self._client = client
         self.cid = cid
         self.group = group
@@ -410,25 +949,115 @@ class RemoteBusConsumer:
         # tracer on the RemoteEventBus, every delivered record whose
         # value carries a BatchContext records a `wire.poll` span — the
         # broker-hop queue wait (append wall time → delivery) that used
-        # to be dark in a split deployment's critical path
+        # to be dark in a split deployment's critical path. Under
+        # prefetch the span measures broker append → CREDIT DELIVERY
+        # (the deliver frame's arrival), not drain time: time a record
+        # then spends in the local prefetch buffer belongs to this
+        # process, not the broker hop (docs/OBSERVABILITY.md).
         self.tracer = tracer
         self._closed = False
+        self._prefetch = bool(prefetch)
+        self._credit = max(int(prefetch_credit), 1)
+        # prefetch buffer: (row, arrive_monotonic, arrive_wall) — the
+        # arrival stamps are captured when the deliver frame lands
+        self._buf: deque = deque()
+        self._buf_wake = asyncio.Event()
+        self._to_regrant = 0
         # delivered-through positions, tracked CLIENT-side: a bare
-        # commit() must pin exactly what this process has been handed.
-        # Deferring to the server's current positions instead loses the
-        # race against the next poll REQUEST (commit is fire-and-forget,
-        # the poll is written immediately after it is spawned): the
-        # broker serves the new batch first, advances its positions,
-        # and the late commit then covers records this worker never
-        # processed — a SIGKILL in that window breaks at-least-once
-        # (measured: the fleet kill drill lost exactly one in-flight
-        # poll batch per killed consumer before this pin existed).
+        # commit() must pin exactly what THIS PROCESS'S poll() handed
+        # the app — never the broker consumer's positions (which run a
+        # full credit window ahead under prefetch), and never the
+        # prefetch buffer. A SIGKILL between delivery and drain then
+        # redelivers instead of losing the window (the fleet kill drill
+        # lost exactly one in-flight poll batch per killed consumer
+        # before this pin existed; with prefetch the stake is the whole
+        # credit window).
         self._delivered: dict[tuple[str, int], int] = {}
+
+    # -- prefetch push path -------------------------------------------------
+
+    def _on_push(self, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "deliver":
+            now_m = time.monotonic()
+            now_w = time.time()
+            for row in msg.get("rows") or ():
+                self._buf.append((row, now_m, now_w))
+            self._buf_wake.set()
+        elif op == "revoke":
+            # rebalance/seek revoked the credit window: drop the
+            # undrained buffer — those rows re-deliver from committed
+            # offsets (to this member or to whoever owns the partition
+            # now) — and give their credit back
+            dropped = len(self._buf)
+            self._buf.clear()
+            if dropped:
+                self._regrant(dropped)
+
+    def _regrant(self, n: int) -> None:
+        self._to_regrant += n
+        if self._to_regrant >= max(self._credit // 2, 1) \
+                and self.cid >= 0 and not self._closed:
+            try:
+                self._client.call_nowait("credit", cid=self.cid,
+                                         n=self._to_regrant)
+                self._to_regrant = 0
+            except RuntimeError:
+                pass  # no loop (teardown): the window just stays shut
+
+    def _drain_buffer(self, max_records: int) -> list[TopicRecord]:
+        out: list[TopicRecord] = []
+        tracer = self.tracer
+        while self._buf and len(out) < max_records:
+            (t, p, off, key, value, ts), arr_m, arr_w = self._buf.popleft()
+            # cross-process: the producer stamped ctx.ingest_monotonic
+            # in ITS monotonic epoch — re-stamp at delivery into this
+            # process, so downstream latency measures from broker
+            # handoff (buffer residency included: that queue is ours)
+            ctx = getattr(value, "ctx", None)
+            if ctx is not None and hasattr(ctx, "ingest_monotonic"):
+                ctx.ingest_monotonic = arr_m
+                if tracer is not None and ctx.trace_id \
+                        and tracer.sampled(ctx.trace_id):
+                    # broker append → credit delivery, wall clocks
+                    # (no monotonic epoch spans processes; same-host
+                    # skew is µs — docs/OBSERVABILITY.md)
+                    wait = max(arr_w - ts, 0.0)
+                    try:
+                        n = len(value)
+                    except TypeError:
+                        n = 0
+                    tracer.record(ctx.trace_id, "wire.poll",
+                                  ctx.tenant_id, arr_m - wait, wait, n)
+            self._delivered[(t, p)] = off + 1
+            out.append(TopicRecord(t, p, off, key, value, ts))
+        if out:
+            self._regrant(len(out))
+        return out
 
     async def poll(self, *, max_records: int = 512,
                    timeout: float = 1.0) -> list[TopicRecord]:
         if self._closed:
             return []
+        if self._prefetch:
+            # drain the local prefetch buffer; deliver frames land in it
+            # straight from the rx loop (no RPC round trip per poll)
+            await asyncio.sleep(0)  # always yield, like BusConsumer.poll
+            if not self._buf:
+                deadline = time.monotonic() + timeout
+                while not self._buf and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._buf_wake.clear()
+                    if self._buf:
+                        break
+                    try:
+                        await asyncio.wait_for(self._buf_wake.wait(),
+                                               remaining)
+                    except asyncio.TimeoutError:
+                        break
+            return self._drain_buffer(max_records)
         rows = await self._client.call("poll", cid=self.cid,
                                        max_records=max_records,
                                        timeout=timeout)
@@ -436,21 +1065,12 @@ class RemoteBusConsumer:
         now_wall = time.time()
         out = []
         for t, p, off, key, value, ts in rows:
-            # cross-process: the producer stamped ctx.ingest_monotonic in
-            # ITS monotonic epoch, which is unrelated to ours — latency
-            # stages computed against it would be garbage (possibly
-            # negative). Re-stamp at wire decode; admit/e2e latency in a
-            # split deployment measures from broker handoff, documented.
+            # legacy path: re-stamp at wire decode (see _drain_buffer)
             ctx = getattr(value, "ctx", None)
             if ctx is not None and hasattr(ctx, "ingest_monotonic"):
                 ctx.ingest_monotonic = now
                 if self.tracer is not None and ctx.trace_id \
                         and self.tracer.sampled(ctx.trace_id):
-                    # broker-hop queue wait: the record's append wall
-                    # timestamp vs delivery here. Wall clocks, because
-                    # no monotonic epoch spans processes — same-host
-                    # skew is µs; cross-host NTP skew is the documented
-                    # resolution floor (docs/OBSERVABILITY.md).
                     wait = max(now_wall - ts, 0.0)
                     try:
                         n = len(value)
@@ -467,16 +1087,28 @@ class RemoteBusConsumer:
         if positions is None:
             positions = self._delivered
         rows = [[t, p, off] for (t, p), off in positions.items()]
-        # fire-and-forget: a FencedError resolves through the client's
-        # on_fenced callback (WireClient.spawn's done handler), since no
-        # caller awaits this RPC
-        self._client.spawn(
-            self._client.call("commit", cid=self.cid, positions=rows,
-                              fence=fence))
+        # fire-and-forget: rides this tick's coalesced batch frame; a
+        # FencedError in the batched ack resolves through the client's
+        # on_fenced callback, since no caller awaits this op
+        try:
+            self._client.call_nowait("commit", cid=self.cid, positions=rows,
+                                     fence=fence)
+        except RuntimeError:
+            pass  # no loop (teardown)
 
     def snapshot_positions(self):
-        # remote positions snapshot is async; expose the coroutine and
-        # let checkpointing callers await it
+        if self._prefetch:
+            # under prefetch the broker-side consumer's positions run a
+            # full credit window AHEAD of this process (the push loop
+            # reads ahead into the client buffer) — a checkpoint built
+            # from them would commit records poll() never handed the
+            # app, and a kill in that window would LOSE them. The
+            # client-side delivered-through map IS the snapshot; plain
+            # dict (callers guard with inspect.isawaitable).
+            return dict(self._delivered)
+        # legacy RPC mode: broker positions advance only by serving
+        # this client's poll calls, so the remote snapshot equals
+        # delivered-through; expose the coroutine for callers to await
         return self._snapshot()
 
     def delivered_positions(self) -> dict:
@@ -491,14 +1123,23 @@ class RemoteBusConsumer:
 
     def seek_to_beginning(self) -> None:
         self._delivered.clear()  # positions reset with the seek
-        self._client.spawn(self._client.call("seek_begin", cid=self.cid))
+        # prefetch: the broker answers the seek with a revoke push, so
+        # rows delivered before it are dropped client-side and the
+        # stream restarts from the beginning — no mixing
+        try:
+            self._client.call_nowait("seek_begin", cid=self.cid)
+        except RuntimeError:
+            pass
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            self._buf.clear()
+            self._buf_wake.set()
+            if self.cid >= 0:
+                self._client.unregister_push(self.cid)
             try:
-                self._client.spawn(
-                    self._client.call("close_consumer", cid=self.cid))
+                self._client.call_nowait("close_consumer", cid=self.cid)
             except RuntimeError:
                 pass  # no loop (interpreter teardown) — server reaps on drop
 
@@ -509,11 +1150,26 @@ class RemoteEventBus:
 
     Lifecycle-wise it is a leaf component stand-in: `ServiceRuntime`
     accepts it via its `bus=` parameter and starts/stops it like the
-    in-proc bus."""
+    in-proc bus.
 
-    def __init__(self, host: str, port: int, secret: Optional[str] = None):
+    Fast-path levers (InstanceSettings.wire_*): `prefetch` +
+    `prefetch_credit` engage the streaming poll path, `pipeline` +
+    `linger_ms` the per-tick coalesced writes, `inflight_cap` the
+    fire-and-forget backpressure bound. All on by default; the A/B off
+    leg (`bench.py --no-wire-fastpath`) restores the PR-8
+    request/response plane bit for bit."""
+
+    def __init__(self, host: str, port: int, secret: Optional[str] = None,
+                 *, prefetch: bool = True,
+                 prefetch_credit: int = DEFAULT_PREFETCH_CREDIT,
+                 pipeline: bool = True, linger_ms: float = 0.0,
+                 inflight_cap: int = DEFAULT_INFLIGHT_CAP):
         self.host, self.port = host, port
-        self._client = WireClient(host, port, secret=secret)
+        self._client = WireClient(host, port, secret=secret,
+                                  pipeline=pipeline, linger_ms=linger_ms,
+                                  inflight_cap=inflight_cap)
+        self.prefetch = bool(prefetch)
+        self.prefetch_credit = max(int(prefetch_credit), 1)
         # fleet worker id: set by the worker entry (fleet/worker_main)
         # so every membership this process registers is owner-tagged —
         # the broker's death-declaration eviction needs the attribution
@@ -523,6 +1179,45 @@ class RemoteEventBus:
         # traced batches — the cross-process trace stays ONE trace with
         # the hop's queue wait attributed (docs/OBSERVABILITY.md)
         self.tracer = None
+        self._metrics = None
+
+    # -- observability ------------------------------------------------------
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, registry) -> None:
+        """ServiceRuntime wires its registry here: the fast path's
+        gauges/counters (`wire.prefetch_credit`, `wire.linger_batches`,
+        `wire.frames_coalesced`) land beside every other signal."""
+        self._metrics = registry
+        if registry is not None:
+            registry.gauge("wire.prefetch_credit").set(
+                self.prefetch_credit if self.prefetch else 0)
+            self._client.coalesce_gauge = registry.gauge(
+                "wire.linger_batches")
+            self._client.coalesce_counter = registry.counter(
+                "wire.frames_coalesced")
+
+    @property
+    def backlogged(self) -> bool:
+        """Fire-and-forget op window full (stalled broker): the egress
+        stage folds this into its commit-barrier `backlogged`, so
+        consumer loops pause instead of queueing without bound."""
+        return self._client.backlogged
+
+    def wire_stats(self) -> dict:
+        """Client-side fast-path surface (heartbeat signals, tests)."""
+        return {
+            "prefetch": self.prefetch,
+            "prefetch_credit": self.prefetch_credit,
+            "pipeline": self._client.pipeline,
+            "ff_pending": self._client.ff_pending,
+            "backlogged": self._client.backlogged,
+            "frames_coalesced": self._client.frames_coalesced_total,
+        }
 
     # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
     async def initialize(self) -> None:
@@ -563,8 +1258,8 @@ class RemoteEventBus:
 
     @property
     def on_fenced(self):
-        """Callback(tenant) for fire-and-forget fenced rejections —
-        ServiceRuntime wires it to its FenceState so a background
+        """Callback(tenant, epoch) for fire-and-forget fenced rejections
+        — ServiceRuntime wires it to its FenceState so a background
         commit/produce rejection still demotes the zombie owner."""
         return self._client.on_fenced
 
@@ -575,7 +1270,11 @@ class RemoteEventBus:
     async def produce(self, topic: str, value: Any, *,
                       key: Optional[str] = None,
                       partition: Optional[int] = None,
-                      fence=None) -> tuple[int, int]:
+                      fence=None, _sent: Optional[list] = None
+                      ) -> tuple[int, int]:
+        """`_sent` is the publish-settlement probe (WireClient.call):
+        kernel/fastlane.py `produce_settled` threads it so cancellation
+        mid-publish stays unambiguous for commit accounting."""
         tracer = self.tracer
         ctx = getattr(value, "ctx", None)
         # the broker-hop's service half: encode + RPC + append
@@ -588,7 +1287,8 @@ class RemoteEventBus:
                   and getattr(ctx, "trace_id", 0)
                   and tracer.sampled(ctx.trace_id))
         t0 = time.monotonic() if traced else 0.0
-        p, off = await self._client.call("produce", topic=topic, value=value,
+        p, off = await self._client.call("produce", _sent=_sent,
+                                         topic=topic, value=value,
                                          key=key, partition=partition,
                                          fence=fence)
         if traced:
@@ -604,9 +1304,16 @@ class RemoteEventBus:
                        key: Optional[str] = None,
                        partition: Optional[int] = None,
                        fence=None) -> None:
-        self._client.spawn(
-            self.produce(topic, value, key=key, partition=partition,
-                         fence=fence))
+        if self._client.pipeline:
+            # coalescing fast path: the op rides this tick's multi-op
+            # batch frame (no per-produce task, one drain per tick)
+            self._client.call_nowait("produce", topic=topic, value=value,
+                                     key=key, partition=partition,
+                                     fence=fence)
+        else:
+            self._client.spawn(
+                self.produce(topic, value, key=key, partition=partition,
+                             fence=fence))
 
     def subscribe(self, topics: Iterable[str] | str, *, group: str,
                   name: Optional[str] = None,
@@ -619,28 +1326,51 @@ class RemoteEventBus:
         return _LazyRemoteConsumer(self._client, list(topics), group,
                                    name or group,
                                    owner=owner or self.owner,
-                                   tracer=self.tracer)
+                                   tracer=self.tracer,
+                                   prefetch=self.prefetch,
+                                   prefetch_credit=self.prefetch_credit)
 
 
 class _LazyRemoteConsumer(RemoteBusConsumer):
     """RemoteBusConsumer that performs the subscribe RPC on first use."""
 
     def __init__(self, client: WireClient, topics: list, group: str,
-                 name: str, owner: Optional[str] = None, tracer=None):
+                 name: str, owner: Optional[str] = None, tracer=None,
+                 prefetch: bool = False,
+                 prefetch_credit: int = DEFAULT_PREFETCH_CREDIT):
         super().__init__(client, cid=-1, group=group, name=name,
-                         tracer=tracer)
+                         tracer=tracer, prefetch=prefetch,
+                         prefetch_credit=prefetch_credit)
         self.owner = owner
         self._topics = topics
         self._seek_pending = False
 
     async def _ensure(self) -> None:
         if self.cid < 0:
+            seek = self._seek_pending
+            self._seek_pending = False
             self.cid = await self._client.call(
                 "subscribe", topics=self._topics, group=self.group,
-                name=self.name, owner=self.owner)
-            if self._seek_pending:
-                self._seek_pending = False
-                await self._client.call("seek_begin", cid=self.cid)
+                name=self.name, owner=self.owner,
+                # seek rides the subscribe op itself: the broker seeks
+                # BEFORE the first push delivery, so a prefetching
+                # replay consumer never sees committed-position rows
+                seek=seek,
+                prefetch=self._credit if self._prefetch else 0)
+            if self._closed:
+                # closed while the subscribe was in flight: reap the
+                # broker-side consumer we just created, and mark the
+                # cid closed so deliver frames already pushed for it
+                # are dropped instead of parking in the orphan buffer
+                # forever (a credit window of pinned frame bodies)
+                self._client.unregister_push(self.cid)
+                try:
+                    self._client.call_nowait("close_consumer", cid=self.cid)
+                except RuntimeError:
+                    pass
+                return
+            if self._prefetch:
+                self._client.register_push(self.cid, self._on_push)
 
     async def poll(self, *, max_records: int = 512,
                    timeout: float = 1.0) -> list[TopicRecord]:
@@ -649,7 +1379,7 @@ class _LazyRemoteConsumer(RemoteBusConsumer):
 
     def seek_to_beginning(self) -> None:
         # valid before the first poll on the local BusConsumer — queue
-        # the intent and apply it right after the subscribe lands
+        # the intent and apply it with the subscribe op
         if self.cid < 0:
             self._seek_pending = True
         else:

@@ -26,6 +26,7 @@ from sitewhere_tpu.domain.events import (
 )
 from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import egress_lanes
+from sitewhere_tpu.kernel.fastlane import produce_settled
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 from sitewhere_tpu.persistence.memory import InMemoryDeviceEventManagement
@@ -150,6 +151,13 @@ class EventPersister(BackgroundTaskComponent):
         consumer = runtime.bus.subscribe(
             inbound_topic, group=f"{tenant_id}.event-management")
         spi = engine.spi
+        # clean-handoff commit-through (same contract as the inbound
+        # processor): on a wire bus the enriched re-publish suspends, so
+        # a release's cancel can land mid-batch AFTER a record was
+        # persisted + re-published but before the round-end commit — a
+        # redelivery would then store AND score those events twice. The
+        # finally commits the handled prefix exactly.
+        handled: dict[tuple[str, int], int] = {}
         try:
             while True:
                 for record in await consumer.poll(max_records=256, timeout=0.2):
@@ -161,9 +169,11 @@ class EventPersister(BackgroundTaskComponent):
                     except asyncio.CancelledError:
                         raise
                     except _Skip:
+                        handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                         continue
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
+                        handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                         continue
                     # the batch is already persisted: a failed enriched
                     # re-publish must NOT dead-letter it (replay would
@@ -172,10 +182,16 @@ class EventPersister(BackgroundTaskComponent):
                     # DLQ01-disabled for that reason: the broad handler
                     # below never raises, so the loop still survives
                     try:  # swxlint: disable=DLQ01
-                        await runtime.bus.produce(enriched_topic,
-                                                  record.value,
-                                                  key=record.key,
-                                                  fence=engine.fence_token())
+                        # scored-path-critical publish: cancellation
+                        # inside it must not make the handled-through
+                        # commit ambiguous (produce_settled marks the
+                        # record handled when the frame is already on
+                        # the broker's path)
+                        await produce_settled(
+                            runtime.bus, enriched_topic, record.value,
+                            key=record.key, fence=engine.fence_token(),
+                            mark=lambda r=record: handled.__setitem__(
+                                (r.topic, r.partition), r.offset + 1))
                     except asyncio.CancelledError:
                         raise
                     except FencedError:
@@ -189,11 +205,21 @@ class EventPersister(BackgroundTaskComponent):
                         logger.exception(
                             "event-mgmt[%s]: enriched re-publish failed; "
                             "batch persisted but not enriched", tenant_id)
+                    # slotted-attribute reads cannot raise — bookkeeping
+                    handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                 try:
                     consumer.commit(fence=engine.fence_token())
                 except FencedError:
                     engine.fence_lost()
         finally:
+            try:
+                if handled:
+                    # commit the handled prefix (see above); fenced or
+                    # evicted refusals leave the offsets to the owner
+                    consumer.commit(dict(handled),
+                                    fence=engine.fence_token())
+            except (FencedError, RuntimeError):
+                pass
             consumer.close()
 
     def _persist(self, record, spi, runtime, tenant_id, persisted) -> None:

@@ -27,7 +27,11 @@ from sitewhere_tpu.domain.batch import (
 )
 from sitewhere_tpu.kernel.bus import FencedError, TopicNaming
 from sitewhere_tpu.kernel.egresslane import egress_lanes
-from sitewhere_tpu.kernel.fastlane import fastlane_enabled, validate_and_split
+from sitewhere_tpu.kernel.fastlane import (
+    fastlane_enabled,
+    produce_settled,
+    validate_and_split,
+)
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 
@@ -83,6 +87,20 @@ class InboundProcessor(BackgroundTaskComponent):
         consumer = runtime.bus.subscribe(
             decoded_topic, group=f"{tenant_id}.inbound-processing")
         flow = runtime.flow
+        # clean-handoff commit-through: a cancellation (tenant release,
+        # engine stop) can land at ANY await once the bus is a wire bus
+        # (every produce suspends awaiting the broker ack; in-proc it
+        # never does) — including mid-batch, AFTER a record's enriched
+        # output was already published but BEFORE the round-end commit.
+        # Without a final commit of the handled prefix, the adopter
+        # redelivers that record and scores it twice (measured: the
+        # wire straddle drill double-scored exactly the batch in flight
+        # at the release). `handled` tracks per-partition handled-
+        # through offsets; the finally commits exactly that prefix —
+        # published work committed, unhandled records left for the new
+        # owner (the at-least-once bound tightens to exactly-once on a
+        # clean handoff, the same contract the fused lane pins).
+        handled: dict[tuple[str, int], int] = {}
         try:
             while True:
                 # re-resolve each round: a tenant update swaps the dm engine
@@ -113,13 +131,24 @@ class InboundProcessor(BackgroundTaskComponent):
                             # acheck, not check: a delay-mode fault must
                             # suspend this coroutine, not the event loop
                             await runtime.faults.acheck("inbound.handle")
-                        await self._handle(record, dm, runtime, tenant_id,
-                                           inbound_topic, unregistered_topic,
-                                           processed, dropped)
+                        await self._handle(
+                            record, dm, runtime, tenant_id,
+                            inbound_topic, unregistered_topic,
+                            processed, dropped,
+                            # cancellation-unambiguous publish
+                            # accounting (produce_settled): a cancel
+                            # landing inside the enriched publish still
+                            # marks the record handled when its frame
+                            # is already on the broker's path
+                            mark=lambda r=record: handled.__setitem__(
+                                (r.topic, r.partition), r.offset + 1))
                     except asyncio.CancelledError:
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
+                    # slotted-attribute reads on the TopicRecord cannot
+                    # raise — bookkeeping, not record handling
+                    handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                 try:
                     consumer.commit(fence=engine.fence_token())
                 except FencedError:
@@ -127,10 +156,19 @@ class InboundProcessor(BackgroundTaskComponent):
                     # the new owner; the fleet worker stops these engines
                     engine.fence_lost()
         finally:
+            try:
+                if handled:
+                    # commit the handled prefix (see above); fenced or
+                    # evicted refusals leave the offsets to the owner
+                    consumer.commit(dict(handled),
+                                    fence=engine.fence_token())
+            except (FencedError, RuntimeError):
+                pass
             consumer.close()
 
     async def _handle(self, record, dm, runtime, tenant_id, inbound_topic,
-                      unregistered_topic, processed, dropped) -> None:
+                      unregistered_topic, processed, dropped,
+                      mark=None) -> None:
         engine = self.engine
         batch = record.value
         t_span = time.monotonic()
@@ -149,9 +187,13 @@ class InboundProcessor(BackgroundTaskComponent):
                                              fence=engine.fence_token())
             if len(batch):
                 processed.mark(len(batch))
-                await runtime.bus.produce(inbound_topic, batch,
-                                          key=record.key,
-                                          fence=engine.fence_token())
+                # the scored-path-critical publish: cancellation inside
+                # it must not make the handled-through commit ambiguous
+                # (kernel/fastlane.py produce_settled)
+                await produce_settled(runtime.bus, inbound_topic, batch,
+                                      key=record.key,
+                                      fence=engine.fence_token(),
+                                      mark=mark)
             runtime.tracer.record(
                 batch.ctx.trace_id, "inbound.enrich", tenant_id,
                 t_span, time.monotonic() - t_span, len(batch))

@@ -1,0 +1,221 @@
+"""Adversarial-frame suite for the wire codec (kernel/codec.py).
+
+The contract under attack: ANY malformed frame — truncation at every
+byte, bogus tags, length prefixes that lie (past the frame, past
+MAX_FRAME), unregistered class names, ndarray headers whose dtype/shape
+disagree with their payload — raises the TYPED `WireFormatError` (a
+ValueError), and no partially-constructed object escapes. The zero-copy
+decode path (`copy_arrays=False`, what the wire rx loops run) must pass
+the identical suite."""
+
+import dataclasses
+import struct
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+from sitewhere_tpu.kernel import codec
+from sitewhere_tpu.kernel.codec import WireFormatError
+
+BOTH_MODES = pytest.mark.parametrize("copy_arrays", [True, False],
+                                     ids=["copy", "zero-copy"])
+
+
+def _sample_payload() -> bytes:
+    ctx = BatchContext(tenant_id="t", source="s", trace_id=7)
+    batch = MeasurementBatch(
+        ctx, np.arange(512, dtype=np.uint32),
+        np.zeros(512, np.uint16),
+        np.linspace(0, 1, 512).astype(np.float32),
+        np.full(512, 1700000000.0))
+    return codec.encode({"op": "produce", "topic": "x", "value": batch,
+                         "key": "s", "meta": [1, (2.5, None), {"a": b"b"}]})
+
+
+@BOTH_MODES
+def test_truncation_at_every_boundary_is_typed(copy_arrays):
+    """Cutting the frame anywhere raises WireFormatError — never a bare
+    struct.error / IndexError, never a partial value."""
+    payload = _sample_payload()
+    # every prefix of a real frame, stepped to keep the suite fast but
+    # covering every header/length/payload boundary region
+    cuts = set(range(0, 64)) \
+        | set(range(64, len(payload), 97)) | {len(payload) - 1}
+    for cut in sorted(cuts):
+        with pytest.raises(ValueError) as exc_info:
+            codec.decode(payload[:cut], copy_arrays=copy_arrays)
+        assert isinstance(exc_info.value, (WireFormatError,)), (
+            f"cut at {cut} raised untyped {exc_info.value!r}")
+
+
+@BOTH_MODES
+def test_bogus_tags_refused(copy_arrays):
+    for tag in (13, 42, 200, 255):
+        with pytest.raises(WireFormatError):
+            codec.decode(bytes([tag]) + b"\x00" * 16,
+                         copy_arrays=copy_arrays)
+
+
+@BOTH_MODES
+def test_oversized_length_prefix_refused(copy_arrays):
+    # a tiny frame claiming a huge string/bytes body: the prefix check
+    # must fire before any allocation or read
+    for tag in (codec.T_STR, codec.T_BYTES):
+        huge = bytes([tag]) + struct.pack("<I", 0xFFFFFFF0) + b"x"
+        with pytest.raises(WireFormatError):
+            codec.decode(huge, copy_arrays=copy_arrays)
+    # ... and a prefix past MAX_FRAME is refused even if somehow backed
+    claim = bytes([codec.T_BYTES]) + struct.pack("<I", codec.MAX_FRAME + 1)
+    with pytest.raises(WireFormatError):
+        codec.decode(claim, copy_arrays=copy_arrays)
+    # container counts lie too: a list claiming 2^31 elements dies on
+    # the bounds gate, not after looping
+    biglist = bytes([codec.T_LIST]) + struct.pack("<I", 0x7FFFFFFF)
+    with pytest.raises(WireFormatError):
+        codec.decode(biglist, copy_arrays=copy_arrays)
+
+
+@BOTH_MODES
+def test_unregistered_dataclass_and_enum_refused(copy_arrays):
+    payload = bytearray(codec.encode(BatchContext(tenant_id="t")))
+    payload = payload.replace(b"BatchContext", b"EvilClsNeverX")
+    with pytest.raises(WireFormatError):
+        codec.decode(bytes(payload), copy_arrays=copy_arrays)
+    from sitewhere_tpu.domain.events import AlertLevel, DeviceAlert
+
+    enc = bytearray(codec.encode(DeviceAlert(level=AlertLevel.ERROR,
+                                             message="hot")))
+    enc = enc.replace(b"AlertLevel", b"EvilLevelX")
+    with pytest.raises(WireFormatError):
+        codec.decode(bytes(enc), copy_arrays=copy_arrays)
+
+
+@BOTH_MODES
+def test_dataclass_field_mismatch_no_partial_construction(copy_arrays):
+    """A registered class name with hostile field names must raise
+    typed — the class is never constructed with garbage kwargs."""
+    constructed = []
+
+    @dataclasses.dataclass
+    class _CanaryNeverBuilt:
+        x: int = 0
+
+        def __post_init__(self):
+            constructed.append(self)
+
+    codec.register_class(_CanaryNeverBuilt)
+    try:
+        payload = bytearray(codec.encode(_CanaryNeverBuilt(x=1)))
+        # rename the field: x -> q (same length keeps offsets valid)
+        idx = payload.rindex(b"\x01\x00\x00\x00x")
+        payload[idx + 4:idx + 5] = b"q"
+        constructed.clear()
+        with pytest.raises(WireFormatError):
+            codec.decode(bytes(payload), copy_arrays=copy_arrays)
+        assert not constructed, "partial construction escaped"
+    finally:
+        codec._CLASSES.pop("_CanaryNeverBuilt", None)
+
+
+@BOTH_MODES
+def test_enum_bad_value_refused(copy_arrays):
+    from sitewhere_tpu.domain.events import AlertLevel
+
+    enc = bytearray(codec.encode(AlertLevel.ERROR))
+    # the enum value rides as a tagged scalar at the tail — replace it
+    # with an int no AlertLevel maps to
+    enc[-8:] = struct.pack("<q", 2 ** 40)
+    with pytest.raises(WireFormatError):
+        codec.decode(bytes(enc), copy_arrays=copy_arrays)
+
+
+@BOTH_MODES
+def test_ndarray_dtype_lying_headers_refused(copy_arrays):
+    a = np.arange(16, dtype=np.float32)
+    good = bytearray(codec.encode(a))
+
+    def mutated(offset, repl):
+        out = bytearray(good)
+        out[offset:offset + len(repl)] = repl
+        return bytes(out)
+
+    # layout: tag | u32 dtype-len | dtype | u32 ndim | u32 dim | u32 nbytes
+    dlen = struct.unpack_from("<I", good, 1)[0]
+    dim_off = 1 + 4 + dlen + 4
+    nbytes_off = dim_off + 4
+    # (a) shape lies: claims 17 elements over a 16-element payload
+    with pytest.raises(WireFormatError):
+        codec.decode(mutated(dim_off, struct.pack("<I", 17)),
+                     copy_arrays=copy_arrays)
+    # (b) nbytes lies vs shape × itemsize
+    with pytest.raises(WireFormatError):
+        codec.decode(mutated(nbytes_off, struct.pack("<I", 60)),
+                     copy_arrays=copy_arrays)
+    # (c) dtype string lies about width: <f8 over 16 f4 elements makes
+    # shape × itemsize disagree with the 64-byte payload
+    with pytest.raises(WireFormatError):
+        codec.decode(bytes(good).replace(b"<f4", b"<f8"),
+                     copy_arrays=copy_arrays)
+    # (d) garbage dtype string
+    with pytest.raises(WireFormatError):
+        codec.decode(bytes(good).replace(b"<f4", b"@@@"),
+                     copy_arrays=copy_arrays)
+    # (e) object dtype is refused outright (the pickle hole)
+    with pytest.raises(WireFormatError):
+        codec.decode(bytes(good).replace(b"<f4", b"|O1"),
+                     copy_arrays=copy_arrays)
+    # (f) absurd ndim
+    with pytest.raises(WireFormatError):
+        codec.decode(mutated(1 + 4 + dlen, struct.pack("<I", 10 ** 6)),
+                     copy_arrays=copy_arrays)
+
+
+@BOTH_MODES
+def test_trailing_bytes_refused(copy_arrays):
+    with pytest.raises(WireFormatError):
+        codec.decode(codec.encode({"a": 1}) + b"\x00",
+                     copy_arrays=copy_arrays)
+
+
+@BOTH_MODES
+def test_good_frames_still_roundtrip(copy_arrays):
+    """The hardening must not reject a single honest frame — the full
+    round trip from tests/test_wire.py, in both copy modes."""
+    payload = _sample_payload()
+    out = codec.decode(payload, copy_arrays=copy_arrays)
+    batch = out["value"]
+    np.testing.assert_array_equal(batch.device_index,
+                                  np.arange(512, dtype=np.uint32))
+    np.testing.assert_array_equal(
+        batch.value, np.linspace(0, 1, 512).astype(np.float32))
+    assert batch.ctx.trace_id == 7
+    assert out["meta"] == [1, (2.5, None), {"a": b"b"}]
+    if not copy_arrays:
+        # the zero-copy contract: views over the frame, read-only
+        assert not batch.value.flags.writeable
+        with pytest.raises(ValueError):
+            batch.value[0] = 9.0
+
+
+def test_segments_equal_bytes():
+    """encode_segments is byte-identical to encode (the scatter-gather
+    path changes the write shape, never the wire format)."""
+    values = [None, {"k": [1, 2.5, "s", b"b"]},
+              np.arange(4096, dtype=np.float32),   # SG-eligible column
+              np.arange(3, dtype=np.int64),        # below the SG floor
+              _sample_payload_value()]
+    for v in values:
+        segs, total = codec.encode_segments(v)
+        joined = b"".join(bytes(s) for s in segs)
+        assert len(joined) == total
+        assert joined == codec.encode(v)
+
+
+def _sample_payload_value():
+    ctx = BatchContext(tenant_id="t", source="s", trace_id=3)
+    return MeasurementBatch(
+        ctx, np.arange(2048, dtype=np.uint32),
+        np.zeros(2048, np.uint16),
+        np.linspace(0, 1, 2048).astype(np.float32),
+        np.full(2048, 1700000000.0))

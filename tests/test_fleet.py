@@ -143,7 +143,15 @@ async def _seed_registries(bus, cfgs, *, instance_id="fleet-test"):
 
 @contextlib.asynccontextmanager
 async def fleet(tmp_path, n_workers=2, n_tenants=2, *, rest=False,
-                policy=None, spawner=None):
+                policy=None, spawner=None, wire=False, wire_prefetch=True,
+                wire_pipeline=True, wire_prefetch_credit=64):
+    """In-proc fleet harness. With `wire=True` the workers attach to the
+    driver's bus over a REAL BusServer socket (RemoteEventBus), so the
+    wire data plane — streaming prefetch, pipelined produce, the codec
+    — sits under every worker-side record; `wire_prefetch`/
+    `wire_pipeline` are the fast-path A/B levers
+    (tests/test_wire_prefetch.py re-runs the kill-drill and straddle
+    invariants through it)."""
     cfgs = [TenantConfig(tenant_id=f"t{i}",
                          sections={"rule-processing": dict(RP_SECTION)})
             for i in range(n_tenants)]
@@ -160,12 +168,27 @@ async def fleet(tmp_path, n_workers=2, n_tenants=2, *, rest=False,
         spawner=spawner)
     driver.add_child(controller)
     await driver.start()
+    broker = None
+    if wire:
+        from sitewhere_tpu.kernel.wire import BusServer
+
+        broker = BusServer(driver.bus)
+        await broker.start()
     await _seed_registries(driver.bus, cfgs)
     workers = {}
     runtimes = {}
     for i in range(n_workers):
         wid = f"w{i}"
-        rt, worker = _worker_runtime(driver.bus, wid, tmp_path)
+        bus = driver.bus
+        if wire:
+            from sitewhere_tpu.kernel.wire import RemoteEventBus
+
+            bus = RemoteEventBus("127.0.0.1", broker.port,
+                                 prefetch=wire_prefetch,
+                                 pipeline=wire_pipeline,
+                                 prefetch_credit=wire_prefetch_credit)
+            bus.owner = wid
+        rt, worker = _worker_runtime(bus, wid, tmp_path)
         await rt.start()
         runtimes[wid] = rt
         workers[wid] = worker
@@ -181,6 +204,8 @@ async def fleet(tmp_path, n_workers=2, n_tenants=2, *, rest=False,
         for rt in runtimes.values():
             if rt.status.value != "stopped":
                 await rt.stop()
+        if broker is not None:
+            await broker.stop()
         await driver.stop()
 
 
@@ -228,15 +253,28 @@ async def _crash(runtimes, workers, wid):
     """Kill a worker with crash fidelity: no leave, no releases — its
     loops just stop and its engines vanish (in-proc stand-in for
     SIGKILL; the consumers leave their groups exactly as the broker's
-    on_disconnect reaps a dead wire peer's)."""
+    on_disconnect reaps a dead wire peer's). On a wire-attached worker
+    the client is KILLED first (socket drops, no reconnect, no final
+    commits), so the broker sees exactly what a SIGKILLed process
+    leaves behind — including a prefetch credit window mid-flight."""
     worker = workers.pop(wid)
     rt = runtimes.pop(wid)
+    client = getattr(rt.bus, "_client", None)
+    if client is not None:
+        client.kill()
     for loop in (worker._control, worker._apply):
         if loop._task is not None:
             loop._task.cancel()
     worker.owned.clear()          # _do_stop must not release/announce
     rt.remove_child(worker)
-    await rt.stop()
+    try:
+        await rt.stop()
+    except Exception:  # noqa: BLE001 - crash fidelity: a SIGKILLed
+        # process runs no stop path at all; with the wire client killed,
+        # stop-path produces (replicator seal, final commits) fail — the
+        # partial teardown IS the crash being simulated
+        if client is None:
+            raise
 
 
 # ---------------------------------------------------------------------------
