@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
 import os
 import subprocess
@@ -265,6 +266,11 @@ def run_supervised(args, argv: list) -> int:
         # fleet mode: worker spawn/converge rides ready_timeout; the
         # kill drill adds one more flood + an extended drain
         inner_timeout += args.seconds + args.drain_timeout + 240.0
+    if getattr(args, "ramp", False):
+        # ramp drill: calibration + seed + training + ramp + extended
+        # drain + kill drill, each with converge slack
+        inner_timeout += (args.ramp_seed_seconds + args.ramp_seconds
+                          + 2 * args.drain_timeout + 600.0)
     for attempt in (1, 2):
         cmd = [sys.executable, os.path.abspath(__file__), "--inner", *argv,
                *cpu_extra_args]
@@ -1181,6 +1187,527 @@ async def run_fleet_bench(args) -> dict:
             "tenants": n_tenants,
             "fleet_devices": args.devices,
             "chaos": chaos,
+            "lint": _lint_summary(),
+            "chips": n_chips, "device_kind": device_kind,
+            "platform": platform,
+        }
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 20.0
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        await broker.stop()
+        await rt.stop()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# --ramp: predictive-autoscaling traffic-ramp drill (ISSUE 17, ROADMAP
+# item 2's ADApt loop made predictive).
+#
+# Topology = the fleet bench's (bus+ingress+controller | worker
+# processes), but the autoscaler is LIVE (min 1, max --ramp-max-workers)
+# and traffic is a paced RAMP instead of a bounded flood: one "good"
+# tenant stays at a constant low rate (its wall-clock scored latency is
+# the collateral-damage number), the others ramp toward an aggregate
+# offered load of --ramp-peak × the measured single-worker saturation,
+# with one tenant bursting at the midpoint. The headline number is
+# backlog event-seconds (the integral of outstanding accepted events
+# over the ramp + drain) — the cost a ~15s JAX worker startup turns
+# into user-visible lag when scaling starts only AFTER the backlog
+# exists. `--no-forecast` runs the reactive-only leg of the A/B
+# (scripts/ab_compare.py predictive).
+# ---------------------------------------------------------------------------
+
+
+async def run_ramp_bench(args) -> dict:
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cache_dir = os.path.join(repo, ".jax_cache")
+    from sitewhere_tpu.config import InstanceSettings, TenantConfig
+    from sitewhere_tpu.domain.model import DeviceType
+    from sitewhere_tpu.fleet import AutoscalerPolicy, FleetController
+    from sitewhere_tpu.kernel.bus import EventBus
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+    from sitewhere_tpu.kernel.wire import BusServer
+    from sitewhere_tpu.services import (
+        DeviceManagementService,
+        EventSourcesService,
+    )
+    from sitewhere_tpu.sim.simulator import DeviceSimulator, SimConfig
+
+    import logging
+
+    logging.getLogger("sitewhere_tpu.fleet").setLevel(logging.INFO)
+    platform, device_kind, n_chips = probe_backend()
+    forecast_on = bool(args.forecast)
+    n_tenants = args.tenants if args.tenants > 1 else 4
+    per_tenant = max(args.devices // n_tenants, 1)
+    force_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+    data_dir = tempfile.mkdtemp(prefix="swx-ramp-bench-")
+    tenant_ids = [f"bench{i}" for i in range(n_tenants)]
+    good = tenant_ids[0]                       # constant-rate bystander
+    burst = tenant_ids[-1]                     # midpoint step tenant
+    ramp_tenants = tenant_ids[1:-1] or [burst]
+
+    bus = EventBus(default_partitions=4, retention=65536)
+    rt = ServiceRuntime(InstanceSettings(
+        instance_id="ramp-bench", bus_retention=65536,
+        engine_ready_timeout_s=args.ready_timeout,
+        fleet_interval_s=0.25, fleet_dead_after_s=6.0,
+        flow_degrade_at=10.0, flow_defer_at=10.0,
+        fleet_observe=True,
+        data_dir=os.path.join(data_dir, "controller"),
+        # 1s history windows: the forecaster's timestep — a 15s horizon
+        # is then ~14 steps of a 16-step window, inside the ~13-19s JAX
+        # worker-startup lead the planner is meant to buy back
+        observe_history_window_s=1.0,
+        fleet_forecast=forecast_on,
+        fleet_forecast_window=16,
+        fleet_forecast_interval_s=0.5,
+        fleet_forecast_min_windows=8), bus=bus)
+    rt.add_service(EventSourcesService(rt))
+
+    reg_rt = ServiceRuntime(InstanceSettings(
+        instance_id="ramp-bench", registry_replication=True), bus=bus)
+    reg_rt.add_service(DeviceManagementService(reg_rt))
+    await reg_rt.start()
+    for tid in tenant_ids:
+        await reg_rt.add_tenant(TenantConfig(tenant_id=tid))
+        dm = reg_rt.api("device-management").management(tid)
+        dm.bootstrap_fleet(DeviceType(token="thermo", name="T"),
+                           per_tenant)
+    await reg_rt.stop()
+
+    procs: dict[str, subprocess.Popen] = {}
+    wids = iter(range(10_000))
+    broker = BusServer(bus)
+
+    def spawn_worker() -> str:
+        wid = f"w{next(wids)}"
+        cfg = {
+            "worker_id": wid, "host": "127.0.0.1", "port": broker.port,
+            "instance_id": "ramp-bench", "force_cpu": force_cpu,
+            "jax_cache": cache_dir, "log_level": "WARNING",
+            "settings": {
+                "engine_ready_timeout_s": args.ready_timeout,
+                "fleet_heartbeat_s": 0.25,
+                "flow_degrade_at": 10.0, "flow_defer_at": 10.0,
+                "observe_export": True,
+                "observe_history": False,
+                "data_dir": os.path.join(data_dir, wid),
+            },
+        }
+        env = dict(os.environ)
+        if force_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs[wid] = subprocess.Popen(
+            [sys.executable, "-m", "sitewhere_tpu.fleet.worker_main",
+             json.dumps(cfg)],
+            stdout=subprocess.DEVNULL, env=env, cwd=repo)
+        return wid
+
+    # the LIVE autoscaler: scale-up on lag is the thing under test;
+    # scale-down is pinned off so a mid-ramp shrink can't muddy the
+    # A/B. scale_up_lag starts DISARMED (1e18) — the calibration
+    # flood's deliberate backlog must not spawn a worker before the
+    # ramp; `--ramp-scale-lag` is armed at ramp start (both legs, and
+    # decide()/PredictivePlanner both read the policy live)
+    controller = FleetController(
+        rt,
+        policy=AutoscalerPolicy(min_workers=1,
+                                max_workers=args.ramp_max_workers,
+                                scale_up_lag=1e18,
+                                scale_down_lag=0.0,
+                                cooldown_s=8.0,
+                                imbalance_ratio=1e18),
+        spawner=spawn_worker)
+    rt.add_child(controller)
+    await rt.start()
+    await broker.start()
+    controller.request_replica()
+
+    rp_section = {
+        "model": args.model, "model_config": {"window": args.window},
+        "threshold": 6.0, "batch_window_ms": args.window_ms,
+        "buckets": [per_tenant], "capacity": per_tenant,
+        "max_inflight": args.max_inflight,
+        "megabatch": {"enabled": args.megabatch},
+    }
+    try:
+        for tid in tenant_ids:
+            await rt.add_tenant(TenantConfig(tenant_id=tid, sections={
+                "rule-processing": dict(rp_section)}))
+        t0 = time.monotonic()
+        while True:
+            snap = controller.snapshot()
+            if snap["converged"] and len(snap["workers"]) >= 1:
+                break
+            dead = [w for w, p in procs.items() if p.poll() is not None]
+            if dead:
+                raise RuntimeError(
+                    f"ramp worker(s) died during startup: {dead}")
+            if time.monotonic() - t0 > args.ready_timeout:
+                raise TimeoutError(
+                    f"fleet did not converge in {args.ready_timeout}s: "
+                    f"{snap['workers']}")
+            await asyncio.sleep(0.25)
+        converge_s = time.monotonic() - t0
+
+        sims = {tid: DeviceSimulator(
+            SimConfig(num_devices=per_tenant, anomaly_rate=0.001,
+                      anomaly_magnitude=12.0), tenant_id=tid)
+            for tid in tenant_ids}
+        receivers = {tid: rt.api("event-sources").engine(tid)
+                     .receiver("default") for tid in tenant_ids}
+        meters = {tid: bus.subscribe(
+            rt.naming.tenant_topic(tid, "scored-events"),
+            group="ramp-bench-meter") for tid in tenant_ids}
+        scored = {tid: 0 for tid in tenant_ids}
+        sent_total = {tid: 0 for tid in tenant_ids}
+        good_lat: list[float] = []
+        collect_lat = False
+
+        def drain_scored() -> None:
+            now = time.time()
+            for tid, consumer in meters.items():
+                for record in consumer.poll_nowait(max_records=256):
+                    scored[tid] += len(record.value)
+                    if collect_lat and tid == good:
+                        ts = getattr(record.value, "ts", None)
+                        if ts is not None and len(ts):
+                            good_lat.append(now - float(ts.max()))
+
+        async def drain_until(bound: float) -> bool:
+            deadline = time.monotonic() + bound
+            while time.monotonic() < deadline:
+                drain_scored()
+                if all(scored[t] >= sent_total[t] for t in tenant_ids):
+                    return True
+                await asyncio.sleep(0.05)
+            return all(scored[t] >= sent_total[t] for t in tenant_ids)
+
+        async def paced_phase(seconds: float, rate_fn, *,
+                              kill_at: float = -1.0):
+            """Offered load paced per tenant by `rate_fn(elapsed) ->
+            {tid: events/s}`; integrates outstanding accepted events
+            over wall time (backlog event-seconds)."""
+            next_due = {tid: time.monotonic() for tid in tenant_ids}
+            t0 = time.monotonic()
+            last_sample = t0
+            backlog_es = 0.0
+            backlog_peak = 0
+            timeline = []
+            next_timeline = 0.0
+            kill_info = None
+            while time.monotonic() - t0 < seconds:
+                now = time.monotonic()
+                el = now - t0
+                for tid, ev_s in rate_fn(el).items():
+                    if ev_s <= 0.0 or now < next_due[tid]:
+                        continue
+                    interval = per_tenant / ev_s
+                    payload, _ = sims[tid].payload(t=time.time())
+                    if await receivers[tid].submit(payload):
+                        sent_total[tid] += per_tenant
+                    # late loop iterations must not compound into a
+                    # burst: due times track the pace but never fall
+                    # more than one interval behind
+                    next_due[tid] = max(next_due[tid] + interval,
+                                        now - interval)
+                if kill_at >= 0 and kill_info is None and el >= kill_at:
+                    snap = controller.snapshot()
+                    cands = sorted(
+                        ((len(w["owned"]), wid)
+                         for wid, w in snap["workers"].items()
+                         if wid in procs and procs[wid].poll() is None),
+                        reverse=True)
+                    if cands:
+                        victim = cands[0][1]
+                        procs[victim].kill()
+                        kill_info = {
+                            "worker": victim,
+                            "owned": snap["workers"][victim]["owned"],
+                            "t_kill": time.monotonic()}
+                        print(f"[ramp bench] SIGKILL {victim}",
+                              file=sys.stderr)
+                drain_scored()
+                now2 = time.monotonic()
+                outstanding = sum(sent_total[t] - scored[t]
+                                  for t in tenant_ids)
+                backlog_es += max(outstanding, 0) * (now2 - last_sample)
+                backlog_peak = max(backlog_peak, outstanding)
+                last_sample = now2
+                if el >= next_timeline:
+                    timeline.append({
+                        "t": round(el, 1),
+                        "outstanding": int(outstanding),
+                        "workers_live": len(
+                            controller.snapshot()["workers"])})
+                    next_timeline = el + 2.0
+                await asyncio.sleep(0.004)
+            return backlog_es, backlog_peak, timeline, kill_info
+
+        # ---- calibration: single-worker saturation (bounded flood) ----
+        outstanding_cap = per_tenant * 16
+
+        async def _flood(seconds: float) -> None:
+            t_f = time.monotonic()
+            while time.monotonic() - t_f < seconds:
+                progressed = False
+                for tid in tenant_ids:
+                    if sent_total[tid] - scored[tid] >= outstanding_cap:
+                        continue
+                    payload, _ = sims[tid].payload(t=time.time())
+                    if await receivers[tid].submit(payload):
+                        sent_total[tid] += per_tenant
+                        progressed = True
+                drain_scored()
+                if not progressed:
+                    await asyncio.sleep(0.002)
+
+        # uncounted warm-up flood first: the per-tenant engines'
+        # first-batch compiles land HERE, not inside the measured
+        # window — an A/B leg that pays compile during calibration
+        # reads a fraction of the rig's real rate and shapes its whole
+        # ramp from it (observed: 44k vs 118k between two legs of the
+        # same comparison, i.e. the two legs ran different drills)
+        await _flood(3.0)
+        if args.ramp_sat_rate > 0:
+            sat_rate = float(args.ramp_sat_rate)  # pinned by the A/B driver
+        else:
+            calib_s = 5.0
+            base = dict(scored)
+            t0 = time.monotonic()
+            await _flood(calib_s)
+            sat_rate = sum(scored[t] - base[t] for t in tenant_ids) \
+                / (time.monotonic() - t0)
+        await drain_until(args.drain_timeout)
+        sat_rate = max(sat_rate, float(n_tenants))  # degenerate-rig floor
+        print(f"[ramp bench] single-worker saturation ≈ "
+              f"{sat_rate:,.0f} ev/s", file=sys.stderr)
+
+        # offered-load schedule, in fractions of measured saturation
+        good_hz = 0.04 * sat_rate
+        seed_hz = 0.03 * sat_rate
+        peak_each = (args.ramp_peak - 0.04) * sat_rate \
+            / max(len(ramp_tenants) + 1, 1)
+
+        def seed_rates(_el):
+            rates = {tid: seed_hz for tid in tenant_ids}
+            rates[good] = good_hz
+            return rates
+
+        def ramp_rates(el):
+            frac = min(el / max(args.ramp_seconds, 1e-9), 1.0)
+            rates = {good: good_hz}
+            for tid in ramp_tenants:
+                rates[tid] = seed_hz + (peak_each - seed_hz) * frac
+            rates[burst] = (peak_each if el >= 0.5 * args.ramp_seconds
+                            else seed_hz)
+            return rates
+
+        # ---- seed: steady light load builds the history the
+        # forecaster trains on (1s windows on the controller tier).
+        # Sample the autoscaler's OWN load signal through it: its
+        # steady-state peak is the signal's noise floor, and the armed
+        # bar must clear it or reactive fires the instant the ramp
+        # starts (same-units anchoring — the event-weighted signal has
+        # no fixed relationship to offered ev/s across rigs) ----
+        seed_load_samples: list[float] = []
+
+        async def _seed_load_sampler():
+            while True:
+                try:
+                    loads = controller.worker_loads()
+                    if loads:
+                        seed_load_samples.append(max(loads.values()))
+                except Exception:  # noqa: BLE001 - sampler must not kill the bench
+                    pass
+                await asyncio.sleep(0.5)
+
+        sampler = asyncio.ensure_future(_seed_load_sampler())
+        try:
+            await paced_phase(args.ramp_seed_seconds, seed_rates)
+            await drain_until(args.drain_timeout)
+        finally:
+            sampler.cancel()
+
+        # ---- train + deploy (forecast leg): the planner's own path —
+        # history readback → trainer → checkpoint → tenant-0 slot ----
+        train_report = None
+        if forecast_on:
+            t_wait = time.monotonic()
+            while controller.planner is None \
+                    and time.monotonic() - t_wait < 15.0:
+                await asyncio.sleep(0.25)
+            if controller.planner is not None:
+                train_report = controller.planner.train_from_history(
+                    steps=80)
+                print(f"[ramp bench] forecaster trained: {train_report}",
+                      file=sys.stderr)
+
+        # ---- the ramp ----
+        # the armed scale-up bar is rig-relative on two axes: well
+        # above the seed-phase noise floor of the load signal (so the
+        # bar means "growth", not "traffic exists"), and a fraction of
+        # the saturation rate (so it sits a few seconds up the
+        # queue-growth curve — shallow enough for the forecast horizon
+        # to buy real lead, deep enough that crossing it is saturation).
+        # In pinned mode (--ramp-sat-rate) the caller owns the bar
+        # outright: an A/B pair must arm the SAME bar on both legs.
+        # The seed anchor is a QUANTILE of the sampled signal, not its
+        # max — paced batches land in bursts, and a single burst spike
+        # as the anchor once pushed the bar to 0.8× saturation and the
+        # forecast lead under the planner's tick cadence
+        seed_load_peak = max(seed_load_samples, default=0.0)
+        seed_load_p90 = (float(np.quantile(seed_load_samples, 0.9))
+                         if seed_load_samples else 0.0)
+        armed_bar = (float(args.ramp_scale_lag) if args.ramp_sat_rate > 0
+                     else max(args.ramp_scale_lag, 2.0 * seed_load_p90,
+                              0.3 * sat_rate))
+        controller.policy = dataclasses.replace(
+            controller.policy, scale_up_lag=armed_bar)  # armed
+        controller._last_scale_t = -1e9  # no cooldown debt from setup
+        collect_lat = True
+        backlog_es, backlog_peak, timeline, _ = await paced_phase(
+            args.ramp_seconds, ramp_rates)
+        # the drain is part of the cost: backlog created by the ramp
+        # keeps hurting until it's chewed through — and the GOOD tenant
+        # doesn't stop sending because the platform is backlogged, so
+        # its paced traffic (and latency accounting) continues through
+        # recovery. A leg that takes 3 minutes to chew its backlog
+        # serves the victim tenant 3 minutes of degraded latency; end
+        # the percentile window at ramp end and that collateral damage
+        # reads as dead air
+        t_drain0 = time.monotonic()
+        last = t_drain0
+        drain_deadline = t_drain0 + args.drain_timeout + 120.0
+        good_interval = per_tenant / max(good_hz, 1e-9)
+        next_good = t_drain0
+        while time.monotonic() < drain_deadline:
+            now2 = time.monotonic()
+            if now2 >= next_good:
+                payload, _ = sims[good].payload(t=time.time())
+                if await receivers[good].submit(payload):
+                    sent_total[good] += per_tenant
+                next_good = max(next_good + good_interval,
+                                now2 - good_interval)
+            drain_scored()
+            now2 = time.monotonic()
+            outstanding = sum(sent_total[t] - scored[t]
+                              for t in tenant_ids)
+            backlog_es += max(outstanding, 0) * (now2 - last)
+            backlog_peak = max(backlog_peak, outstanding)
+            last = now2
+            if sum(sent_total[t] - scored[t] for t in tenant_ids
+                   if t != good) <= 0:
+                break
+            await asyncio.sleep(0.05)
+        ramp_drain_ok = sum(sent_total[t] - scored[t] for t in tenant_ids
+                            if t != good) <= 0
+        collect_lat = False
+        ramp_drain_s = round(time.monotonic() - t_drain0, 2)
+
+        lat = np.sort(np.asarray(good_lat, np.float64)) \
+            if good_lat else np.zeros(1)
+        good_p50 = float(lat[int(0.50 * (len(lat) - 1))]) * 1e3
+        good_p99 = float(lat[int(0.99 * (len(lat) - 1))]) * 1e3
+
+        # ---- kill drill: 0-lost must hold with the autoscaler live ----
+        kill_stats = None
+        live = [w for w, p in procs.items() if p.poll() is None]
+        if len(live) >= 2 and not args.no_fleet_kill:
+            deaths0 = rt.metrics.counter("fleet.worker_deaths").value
+            _, _, _, kill_info = await paced_phase(
+                12.0, seed_rates, kill_at=2.0)
+            reassigned_s = None
+            if kill_info is not None:
+                t_wait = time.monotonic()
+                while time.monotonic() - t_wait < 120.0:
+                    snap = controller.snapshot()
+                    if kill_info["worker"] not in snap["workers"] \
+                            and snap["converged"]:
+                        reassigned_s = round(
+                            time.monotonic() - kill_info["t_kill"], 2)
+                        break
+                    drain_scored()
+                    await asyncio.sleep(0.25)
+            drain_ok = await drain_until(args.drain_timeout + 120.0)
+            lost = sum(max(sent_total[t] - scored[t], 0)
+                       for t in tenant_ids)
+            kill_stats = {
+                "killed_worker": (kill_info or {}).get("worker"),
+                "death_detected": bool(rt.metrics.counter(
+                    "fleet.worker_deaths").value > deaths0),
+                "converged_after_kill_s": reassigned_s,
+                "lost_accepted_events": int(lost),
+                "drain_complete": drain_ok,
+            }
+
+        final = controller.snapshot()
+        decisions = list(controller.decisions)
+        forecast_attributed = [d for d in decisions if "forecast" in d]
+        planner_snap = (controller.planner.snapshot()
+                        if controller.planner is not None else None)
+        for consumer in meters.values():
+            consumer.close()
+        return {
+            "metric": "ramp_backlog_event_seconds",
+            "value": round(backlog_es, 1),
+            "unit": "event-seconds",
+            "vs_baseline": 0.0,
+            "deployment": f"ramp (bus+ingress+controller | live "
+                          f"autoscaler 1..{args.ramp_max_workers})",
+            "forecast_enabled": forecast_on,
+            "ramp": {
+                "saturation_rate": round(sat_rate, 1),
+                "scale_up_lag_armed": round(armed_bar, 1),
+                "seed_load_peak": round(seed_load_peak, 1),
+                "peak_multiple": args.ramp_peak,
+                "seconds": args.ramp_seconds,
+                "seed_seconds": args.ramp_seed_seconds,
+                "backlog_event_seconds": round(backlog_es, 1),
+                "backlog_peak_events": int(backlog_peak),
+                "ramp_drain_s": ramp_drain_s,
+                "ramp_drain_complete": ramp_drain_ok,
+                "good_tenant": good,
+                "good_paced_p50_ms": round(good_p50, 2),
+                "good_paced_p99_ms": round(good_p99, 2),
+                "good_samples": len(good_lat),
+                "timeline": timeline,
+                "workers_final": len(final["workers"]),
+                "converge_s": round(converge_s, 2),
+                "train": train_report,
+                "decisions": decisions,
+                "forecast_attributed_decisions": len(forecast_attributed),
+                "forecast_counters": {
+                    "decisions": rt.metrics.counter(
+                        "fleet.forecast_decisions").value,
+                    "demotions": rt.metrics.counter(
+                        "fleet.forecast_demotions").value,
+                    "trainings": rt.metrics.counter(
+                        "fleet.forecast_trainings").value,
+                },
+                "planner": planner_snap,
+                "kill": kill_stats,
+            },
+            "model": args.model,
+            "tenants": n_tenants,
+            "fleet_devices": args.devices,
             "lint": _lint_summary(),
             "chips": n_chips, "device_kind": device_kind,
             "platform": platform,
@@ -2177,6 +2704,36 @@ def main() -> None:
                              "the ab_compare `wire` preset's off leg "
                              "restores the PR-8 request/response "
                              "broker plane")
+    parser.add_argument("--ramp", action="store_true",
+                        help="traffic-ramp autoscaling drill (live "
+                             "autoscaler + predictive planner): backlog "
+                             "event-seconds and good-tenant paced p99 "
+                             "are the numbers; --no-forecast runs the "
+                             "reactive-only A/B leg")
+    parser.add_argument("--ramp-seconds", type=float, default=45.0,
+                        help="ramp phase length (offered load climbs "
+                             "linearly to --ramp-peak over this span)")
+    parser.add_argument("--ramp-seed-seconds", type=float, default=25.0,
+                        help="steady warm-up that builds the telemetry "
+                             "history the forecaster trains on")
+    parser.add_argument("--ramp-peak", type=float, default=1.4,
+                        help="aggregate offered load at ramp peak, as a "
+                             "multiple of measured single-worker "
+                             "saturation")
+    parser.add_argument("--ramp-max-workers", type=int, default=3)
+    parser.add_argument("--ramp-scale-lag", type=float, default=1500.0,
+                        help="autoscaler scale_up_lag for the ramp drill")
+    parser.add_argument("--ramp-sat-rate", type=float, default=0.0,
+                        help="pin the single-worker saturation rate "
+                             "(ev/s) instead of measuring it — "
+                             "ab_compare feeds leg A's measured rate to "
+                             "leg B so both legs run the SAME offered "
+                             "ramp (run-to-run rig drift otherwise "
+                             "shapes two different drills)")
+    parser.add_argument("--no-forecast", dest="forecast",
+                        action="store_false", default=True,
+                        help="reactive-only leg: fleet_forecast off, "
+                             "everything else identical")
     parser.add_argument("--zombie-drill", action="store_true",
                         help="--workers mode: SIGSTOP the busiest worker "
                              "past dead_after (false-positive death), "
@@ -2336,6 +2893,7 @@ def main() -> None:
         result = (run_train_bench(args) if args.train
                   else run_gnn_bench(args) if args.gnn
                   else asyncio.run(run_split_bench(args)) if args.split
+                  else asyncio.run(run_ramp_bench(args)) if args.ramp
                   else asyncio.run(run_fleet_bench(args))
                   if args.workers > 0
                   else asyncio.run(run_overload_bench(args))

@@ -41,6 +41,15 @@ Presets (the levers bench.py exposes):
               both legs, the plane's overhead A/B (acceptance:
               saturation within 3%); the extra table reports the on
               leg's fleet critical path + history counts
+    predictive on = `--ramp` (forecast-driven autoscaling: the
+              history-trained forecaster served through the tenant-0
+              scoring slot scales up ahead of the ~15s JAX worker
+              startup), off = `--ramp --no-forecast` (reactive only)
+              — SAME live-autoscaler topology both legs. Artifacts at
+              BENCH_predict_on/off.json; acceptance: on beats off on
+              backlog event-seconds AND good-tenant paced p99, on-leg
+              decisions carry forecast provenance, kill drill 0 lost
+              both legs
     wire      on = `--workers N` (wire data-plane fast path:
               streaming poll prefetch + pipelined micro-batched
               produce + zero-copy codec, kernel/wire.py), off =
@@ -184,6 +193,57 @@ def wire_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
     return "\n".join(out)
 
 
+def ramp_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
+    """Predictive-preset table: backlog event-seconds + good-tenant
+    collateral latency (lower is better on both), scale timing, and
+    the forecast-attribution audit."""
+    ra, rb = a.get("ramp") or {}, b.get("ramp") or {}
+    rows = [
+        ("backlog event-seconds (ramp+drain)",
+         f"{rb.get('backlog_event_seconds', 0):,.0f}",
+         f"{ra.get('backlog_event_seconds', 0):,.0f}",
+         ratio(ra.get("backlog_event_seconds", 0.0),
+               rb.get("backlog_event_seconds", 0.0))),
+        ("backlog peak (events)",
+         f"{rb.get('backlog_peak_events', 0):,}",
+         f"{ra.get('backlog_peak_events', 0):,}",
+         ratio(float(ra.get("backlog_peak_events", 0)),
+               float(rb.get("backlog_peak_events", 0)))),
+        ("good-tenant paced p50 / p99 ms",
+         f"{rb.get('good_paced_p50_ms', 0):.1f} / "
+         f"{rb.get('good_paced_p99_ms', 0):.1f}",
+         f"{ra.get('good_paced_p50_ms', 0):.1f} / "
+         f"{ra.get('good_paced_p99_ms', 0):.1f}",
+         ratio(ra.get("good_paced_p99_ms", 0.0),
+               rb.get("good_paced_p99_ms", 0.0))),
+        ("post-ramp drain (s)",
+         f"{rb.get('ramp_drain_s', 0)}", f"{ra.get('ramp_drain_s', 0)}",
+         ""),
+        ("single-worker saturation (ev/s)",
+         f"{rb.get('saturation_rate', 0):,.0f}",
+         f"{ra.get('saturation_rate', 0):,.0f}", ""),
+        ("workers at ramp end",
+         str(rb.get("workers_final")), str(ra.get("workers_final")), ""),
+        ("autoscale decisions (forecast-attributed)",
+         f"{len(rb.get('decisions') or [])} "
+         f"({rb.get('forecast_attributed_decisions', 0)})",
+         f"{len(ra.get('decisions') or [])} "
+         f"({ra.get('forecast_attributed_decisions', 0)})", ""),
+    ]
+    for name, art in ((name_b, rb), (name_a, ra)):
+        kill = art.get("kill")
+        if kill:
+            rows.append((
+                f"kill drill ({name})",
+                "", f"killed {kill.get('killed_worker')}, lost "
+                    f"{kill.get('lost_accepted_events')}, reconverged "
+                    f"{kill.get('converged_after_kill_s')}s", ""))
+    out = [f"| metric | {name_b} | {name_a} | Δ (A vs B) |",
+           "|---|---|---|---|"]
+    out += [f"| {m} | {vb} | {va} | {d} |" for m, vb, va, d in rows]
+    return "\n".join(out)
+
+
 def delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
     """Markdown table, columns = [metric, B, A, delta] — B is the
     baseline (off/lanes=1), A the candidate, matching PERFORMANCE.md's
@@ -275,7 +335,7 @@ def main() -> int:
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
                                            "megabatch", "observe",
                                            "fleet", "mesh", "fleetobs",
-                                           "wire"])
+                                           "wire", "predictive"])
     parser.add_argument("--mesh-shape", default="1x8",
                         help="DxM mesh for the mesh preset's on leg "
                              "(forced host-platform devices on CPU "
@@ -307,7 +367,8 @@ def main() -> int:
         argv, bench_args = argv[:split], argv[split + 1:]
     args = parser.parse_args(argv)
     args.bench_args = bench_args
-    prefix = args.prefix or f"BENCH_{args.preset}"
+    prefix = args.prefix or ("BENCH_predict" if args.preset == "predictive"
+                             else f"BENCH_{args.preset}")
 
     if args.preset == "egress":
         pairs = [("off", ["--no-egress-fusion"]),
@@ -361,6 +422,17 @@ def main() -> int:
         pairs = [("off", ["--workers", w, "--no-wire-fastpath"]),
                  ("on", ["--workers", w])]
         names = (f"wire fast path off (w={w})", f"wire fast path on (w={w})")
+    elif args.preset == "predictive":
+        # SAME topology both legs (live autoscaler, 1..max workers);
+        # the variable is the predictive planner (fleet/forecast.py:
+        # history-trained forecaster served through the tenant-0 slot,
+        # scale-up ahead of the ~15s JAX worker startup). Acceptance:
+        # the on leg beats the off leg on backlog event-seconds AND
+        # good-tenant paced p99, its decisions carry forecast
+        # provenance, and the kill drill loses 0 on both legs.
+        pairs = [("off", ["--ramp", "--no-forecast"]),
+                 ("on", ["--ramp"])]
+        names = ("forecast off (reactive)", "forecast on (predictive)")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",
@@ -368,7 +440,17 @@ def main() -> int:
         names = ("lanes=1", f"lanes={args.lanes}")
 
     artifacts = []
-    for tag, extra in pairs:
+    for i, (tag, extra) in enumerate(pairs):
+        if args.preset == "predictive" and i == 1 and artifacts:
+            # pin leg B's drill to leg A's measured shape: same offered
+            # ramp (ev/s) and same armed scale-up bar — run-to-run rig
+            # drift otherwise calibrates two DIFFERENT drills and the
+            # delta measures the rig, not the planner
+            r0 = artifacts[0].get("ramp") or {}
+            if r0.get("saturation_rate"):
+                extra = extra + [
+                    "--ramp-sat-rate", str(r0["saturation_rate"]),
+                    "--ramp-scale-lag", str(r0["scale_up_lag_armed"])]
         artifact = run_bench(extra, args.bench_args, f"{prefix}_{tag}")
         path = f"{prefix}_{tag}.json"
         with open(path, "w") as f:
@@ -378,7 +460,9 @@ def main() -> int:
         artifacts.append(artifact)
 
     b, a = artifacts  # baseline ran first (off / lanes1 / w1)
-    if args.preset == "fleet":
+    if args.preset == "predictive":
+        print(ramp_delta_table(names[1], a, names[0], b))
+    elif args.preset == "fleet":
         print(fleet_delta_table(names[1], a, names[0], b))
     elif args.preset == "wire":
         print(fleet_delta_table(names[1], a, names[0], b))

@@ -121,6 +121,71 @@ async def main():
 asyncio.run(main())
 PY
 
+# predictive-control smoke (docs/FLEET.md predictive control): a tiny
+# forecaster trained from synthetic telemetry history must deploy
+# through the version-fenced tenant-0 slot on the shared scoring pool
+# and yield ONE forecast-attributed autoscale decision — the training
+# → checkpoint → serve → decide spine fails here in tier-1, not only
+# in the ramp drill.
+env JAX_PLATFORMS=cpu python - <<'PY' || { echo "forecast smoke: FAILED (predictive control plane broken)"; exit 1; }
+import asyncio, math, tempfile, time
+from types import SimpleNamespace
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sitewhere_tpu.config import InstanceSettings
+from sitewhere_tpu.fleet.controller import AutoscalerPolicy
+from sitewhere_tpu.fleet.forecast import PredictivePlanner
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+from sitewhere_tpu.persistence.durable import TelemetryHistory
+
+WS = 1.0
+tmp = tempfile.mkdtemp(prefix="swx-forecast-smoke-")
+h = TelemetryHistory(tmp + "/hist", window_s=WS)
+t0 = math.floor(time.time() / WS) * WS - 60 * WS
+for i in range(58):  # a clean per-tenant load ramp, 1s windows
+    for tid in ("acme", "beta"):
+        h.append(tid, "lag", 40.0 * i, t=t0 + i * WS + 0.5)
+h.flush()
+settings = InstanceSettings(
+    data_dir=tmp + "/data", fleet_forecast_window=16,
+    fleet_forecast_horizon_s=4.0, fleet_forecast_interval_s=0.0,
+    fleet_forecast_min_windows=6)
+runtime = SimpleNamespace(settings=settings, metrics=MetricsRegistry(),
+                          history=h, tracer=None, faults=None)
+c = SimpleNamespace(runtime=runtime,
+                    policy=AutoscalerPolicy(scale_up_lag=300.0,
+                                            cooldown_s=0.0),
+                    tenants={"acme": object(), "beta": object()},
+                    _last_scale_t=-1e9, _pending_spawns=0)
+planner = PredictivePlanner(c)
+report = planner.train_from_history(steps=25)
+assert report is not None and report["version"] >= 1, report
+
+async def main():
+    await planner.tick()  # starts tenant-0 serving + backfills
+    deadline = time.monotonic() + 60.0
+    while not planner.forecasts and time.monotonic() < deadline:
+        wall = time.time()
+        i = (wall - t0) / WS
+        for tid in ("acme", "beta"):
+            h.append(tid, "lag", 40.0 * i, t=wall)
+        await planner.tick()
+        await asyncio.sleep(0.25)
+    return planner.decide({"w1": 1.0}, {})
+
+d = asyncio.run(main())
+try:
+    assert d is not None and d["action"] == "add_replica", \
+        (d, planner.snapshot())
+    assert d["reason"].startswith("forecast:"), d
+    assert d["forecast"]["predicted_load"] > 0, d
+finally:
+    planner.close()
+    h.close()
+print("forecast smoke: OK (trained v%d, one forecast-attributed "
+      "autoscale decision)" % report["version"])
+PY
+
 # fleet-observe smoke (docs/OBSERVABILITY.md fleet observability): a
 # 2-worker trace must stitch end-to-end — ONE origin-scoped trace id
 # whose spine (receive → wire hop → enrich → persist → dispatch →

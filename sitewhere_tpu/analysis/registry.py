@@ -149,6 +149,12 @@ COUNTERS = (
     "fleet.worker_deaths",
     "fleet.autoscale_up",
     "fleet.autoscale_down",
+    # predictive control plane (fleet/forecast.py): forecast-attributed
+    # scale decisions, confidence-gate demotions to pure-reactive, and
+    # forecaster train/deploy rounds through the tenant-0 slot
+    "fleet.forecast_decisions",
+    "fleet.forecast_demotions",
+    "fleet.forecast_trainings",
     # epoch fencing + replicated tenant state (docs/FLEET.md)
     "fence.rejections",   # stale-epoch data-path writes rejected
     "fence.replays",      # journal records replayed on adoption
@@ -184,6 +190,13 @@ GAUGES = (
     "fleet.workers_live",
     "fleet.placement_epoch",
     "fleet.tenants_pending",
+    # predictive control plane (fleet/forecast.py): relative horizon
+    # error EMA (the confidence gate's accuracy signal), the deployed
+    # forecaster checkpoint version, and the live fleet-wide predicted
+    # load at the horizon
+    "fleet.forecast_horizon_error_ema",
+    "fleet.forecast_model_version",
+    "fleet.forecast_load_predicted",
     # mesh-sharded serving + self-tuning dispatch (scoring/pool.py,
     # kernel/egresslane.py): devices under the stacked dispatch, the
     # live adaptive megabatch window, active egress lanes

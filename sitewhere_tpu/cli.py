@@ -666,6 +666,41 @@ def render_fleet_top(report: dict) -> str:
             f"{history.get('windows', 0)} windows  "
             f"{history.get('segments', 0)} segment(s)  "
             f"window {history.get('window_s', 0):.0f}s")
+    forecast = report.get("forecast")
+    if forecast:
+        lines.append("")
+        lines.append(render_forecast(forecast))
+    return "\n".join(lines)
+
+
+def render_forecast(snap: dict) -> str:
+    """Render a predictive-planner snapshot (`GET /api/fleet/forecast`,
+    fleet/forecast.py) — the forecast rows of `swx top --fleet`. Pure
+    function for tests."""
+    gate = snap.get("gate") or "ok"
+    mode = "predictive" if gate == "ok" else f"reactive ({gate})"
+    # error_ema is None until the first horizon check resolves (and
+    # again right after a retrain re-arms the record)
+    ema = snap.get("error_ema")
+    lines = [
+        f"forecast [{mode}] — horizon {snap.get('horizon_s') or 0:.0f}s  "
+        f"model v{snap.get('model_version', 0)}  "
+        f"err-ema {'n/a' if ema is None else format(ema, '.2f')}  "
+        f"decisions {snap.get('decisions', 0)}  "
+        f"demotions {snap.get('demotions', 0)}  "
+        f"trainings {snap.get('trainings', 0)}"]
+    forecasts = snap.get("forecasts") or {}
+    if forecasts:
+        lines.append(f"  {'tenant':<20} {'predicted':>10} {'age':>6} "
+                     f"{'model':>6}")
+        for tid, row in sorted(forecasts.items(),
+                               key=lambda kv: -kv[1].get("load", 0)):
+            lines.append(
+                f"  {tid:<20} {row.get('load', 0):>10.0f} "
+                f"{row.get('age_s', 0):>5.1f}s "
+                f"v{row.get('model_version', 0):>5}")
+    else:
+        lines.append("  (no forecasts yet — tenant-0 slot warming)")
     return "\n".join(lines)
 
 
@@ -728,6 +763,14 @@ async def cmd_top(args) -> int:
                 print(f"swx top: observe failed ({status}): {report}",
                       file=sys.stderr)
                 return 1
+            if fleet_mode:
+                # forecast rows ride the same screen; a 404 just means
+                # the predictive planner isn't running on this host
+                fstatus, fsnap = await _http_json(
+                    "GET", args.host, args.port, "/api/fleet/forecast",
+                    headers=headers)
+                if fstatus == 200:
+                    report["forecast"] = fsnap
             if args.json:
                 print(json.dumps(report))
             else:

@@ -20,6 +20,16 @@ try:  # yaml is present in this image; gate anyway for minimal installs
 except ImportError:  # pragma: no cover
     yaml = None
 
+# The platform's own reserved internal tenant (docs/FLEET.md predictive
+# control): the fleet forecaster deploys under this id through the same
+# version-fenced model-update path and shared megabatch pool as customer
+# tenants (fleet/forecast.py). The id is reserved everywhere a tenant id
+# is accepted — it must never be placed on workers, counted in the
+# per-tenant lag matrix, or admitted through the fair-admission roster
+# (kernel/observe.per_tenant_lags, kernel/flow.FlowController), so the
+# platform's own scoring traffic never reads as customer load.
+RESERVED_TENANT = "tenant-0"
+
 
 @dataclass(frozen=True)
 class InstanceSettings:
@@ -177,6 +187,28 @@ class InstanceSettings:
     fleet_heartbeat_s: float = 1.0
     fleet_dead_after_s: float = 5.0
     fleet_interval_s: float = 0.5      # controller tick / poll cadence
+    # predictive control plane (fleet/forecast.py, docs/FLEET.md): the
+    # controller-host PredictivePlanner reads TelemetryHistory feature
+    # windows, scores them through the shared megabatch pool as the
+    # reserved internal tenant-0, and converts forecasts of per-tenant
+    # load `fleet_forecast_horizon_s` ahead into scale-up decisions
+    # BEFORE backlog forms (the ~13–19 s JAX spawn/first-compile bill a
+    # reactive spawn pays after the fact). Reactive logic stays the
+    # fallback floor: a confidence/staleness gate demotes to
+    # pure-reactive whenever the model is cold (no trained version),
+    # history is thin (< `min_windows` per tenant), the freshest
+    # forecast is stale (> `max_stale_s`), or the realized horizon
+    # error EMA exceeds `error_gate` (relative). `fleet_forecast:
+    # false` (bench `--no-forecast`) is the predictive A/B's off leg —
+    # the planner is then never built and the controller is byte-for-
+    # byte the PR-8 reactive loop.
+    fleet_forecast: bool = True
+    fleet_forecast_horizon_s: float = 15.0
+    fleet_forecast_window: int = 32         # model input steps (ctx+horizon)
+    fleet_forecast_interval_s: float = 1.0  # planner sampling cadence
+    fleet_forecast_min_windows: int = 8     # history-thin demotion bar
+    fleet_forecast_max_stale_s: float = 30.0
+    fleet_forecast_error_gate: float = 3.0  # relative horizon-error EMA bar
     # wire data-plane fast path (kernel/wire.py, docs/PERFORMANCE.md):
     # `wire_prefetch` streams record batches broker→consumer under a
     # credit window of `wire_prefetch_credit` records (poll() drains a

@@ -32,8 +32,9 @@ offsets. docs/FLEET.md is the operator runbook.
 """
 
 from sitewhere_tpu.fleet.controller import AutoscalerPolicy, FleetController
+from sitewhere_tpu.fleet.forecast import FeaturePipeline, PredictivePlanner
 from sitewhere_tpu.fleet.observer import FleetObserver
 from sitewhere_tpu.fleet.worker import FleetWorker
 
 __all__ = ["FleetController", "FleetWorker", "AutoscalerPolicy",
-           "FleetObserver"]
+           "FleetObserver", "FeaturePipeline", "PredictivePlanner"]

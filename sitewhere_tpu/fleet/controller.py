@@ -135,6 +135,10 @@ class FleetController(LifecycleComponent):
 
             self.observer = FleetObserver(runtime)
             self.add_child(self.observer)
+        # predictive control plane (fleet/forecast.py): created lazily
+        # on the first loop tick — it needs the runtime's durable
+        # telemetry history, which the runtime attaches at start
+        self.planner = None
         runtime.fleet = self  # REST `GET /api/fleet` + observe surface
 
     # -- tenant roster (the fleet's source of truth) -------------------------
@@ -142,6 +146,14 @@ class FleetController(LifecycleComponent):
     def add_tenant(self, tenant) -> None:
         """Register (or update) a tenant for placement; the next tick
         publishes the new map and the owning worker spins engines."""
+        from sitewhere_tpu.config import RESERVED_TENANT
+
+        if tenant.tenant_id == RESERVED_TENANT:
+            # the platform's internal tenant-0 (fleet/forecast.py) is
+            # never placed: it scores on the controller host's own pool
+            raise ValueError(
+                f"tenant id {RESERVED_TENANT!r} is reserved for the "
+                "platform's internal scoring slot")
         self.tenants[tenant.tenant_id] = tenant
         self._dirty = True
 
@@ -365,12 +377,19 @@ class FleetController(LifecycleComponent):
 
     def tenant_lags(self) -> dict[str, int]:
         """Per-tenant consumer lag read centrally off the broker bus
-        (tenant consumer groups are `{tenant}.{service}`)."""
+        (tenant consumer groups are `{tenant}.{service}`),
+        EVENT-weighted: scaling decisions must see the queue in events,
+        not record offsets — a backlog of columnar batches is invisible
+        in offset units (one 1024-row batch = 1 offset)."""
         group_lags = getattr(self.runtime.bus, "group_lags", None)
         if group_lags is None:
             return {}
         lags: dict[str, int] = {tid: 0 for tid in self.tenants}
-        for group, by_topic in group_lags().items():
+        try:
+            by_group = group_lags(events=True)
+        except TypeError:  # wire-proxied bus: record units only
+            by_group = group_lags()
+        for group, by_topic in by_group.items():
             tid, _, _ = group.partition(".")
             if tid in lags:
                 lags[tid] += sum(by_topic.values())
@@ -445,10 +464,31 @@ class FleetController(LifecycleComponent):
                                       f"{coolest}'s {loads[coolest]:.0f}"}
         return None
 
+    def _ensure_planner(self) -> None:
+        """Create the predictive planner on first use (fleet/forecast.py):
+        gated on the forecast lever AND the durable telemetry history —
+        without the history there is nothing to train or serve from,
+        and the reactive path alone runs (the fallback floor)."""
+        if self.planner is not None:
+            return
+        if not getattr(self.runtime.settings, "fleet_forecast", True):
+            return
+        if getattr(self.runtime, "history", None) is None:
+            return
+        from sitewhere_tpu.fleet.forecast import PredictivePlanner
+
+        self.planner = PredictivePlanner(self)
+
     def autoscale(self) -> Optional[dict]:
         lags = self.tenant_lags()
         loads = self.worker_loads(lags)
-        decision = self.decide(loads, lags)
+        # predictive first (decisions carry forecast provenance into the
+        # same audit trail), reactive as the fallback floor — the
+        # planner returns None whenever its confidence gate demotes
+        decision = (self.planner.decide(loads, lags)
+                    if self.planner is not None else None)
+        if decision is None:
+            decision = self.decide(loads, lags)
         if decision is None:
             return None
         now = time.monotonic()
@@ -519,6 +559,12 @@ class FleetController(LifecycleComponent):
                 "policy": asdict(self.policy),
                 "decisions": self.decisions[-8:],
             },
+            # predictive control plane (fleet/forecast.py): gate state,
+            # horizon-error EMA, and live per-tenant forecasts — the
+            # brief rendered by `swx top --fleet`; the full view is
+            # `GET /api/fleet/forecast`
+            "forecast": (self.planner.snapshot()
+                         if self.planner is not None else None),
             # epoch fencing (docs/FLEET.md): the broker-side authority's
             # allowed-writer view + rejected-zombie-write count — absent
             # until the first placement record builds the authority
@@ -579,6 +625,13 @@ class _ControllerLoop(BackgroundTaskComponent):
                         force_epoch=c._force_epoch)
                     c._dirty = False
                     c._force_epoch = False
+                c._ensure_planner()
+                if c.planner is not None:
+                    # serve + admit BEFORE deciding: the freshest closed
+                    # window rides into this tick's forecasts
+                    await c.planner.tick()
                 c.autoscale()
         finally:
+            if c.planner is not None:
+                c.planner.close()
             consumer.close()

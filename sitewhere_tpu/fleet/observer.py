@@ -153,7 +153,13 @@ class FleetObserver(LifecycleComponent):
         group_lags = getattr(self.runtime.bus, "group_lags", None)
         if group_lags is None:
             return {}
-        lags = group_lags()
+        try:
+            # event-weighted: the fleet lag matrix and the durable lag
+            # series feed autoscaling — queue depth in events (see
+            # EventBus.group_lags)
+            lags = group_lags(events=True)
+        except TypeError:  # wire-proxied bus: record units only
+            lags = group_lags()
         if not isinstance(lags, dict):
             # wire bus: the broker owns this signal — a wire-attached
             # observer reports beats only (close the stray coroutine)

@@ -183,6 +183,19 @@ class TopicRecord:
     timestamp: float
 
 
+def _event_weight(value: Any) -> int:
+    """Events carried by one record: columnar batches (MeasurementBatch,
+    ScoredBatch — anything with a meaningful `len`) count their rows;
+    control/containter types and scalars count 1. Kept cheap — it runs
+    once per produce on the hot path."""
+    if isinstance(value, (str, bytes, dict, list, tuple)) or value is None:
+        return 1
+    try:
+        return max(int(len(value)), 1)
+    except TypeError:
+        return 1
+
+
 class _PartitionLog:
     """Append-only log for one partition, with bounded retention.
 
@@ -191,18 +204,45 @@ class _PartitionLog:
     wakes on the first record to arrive on any of them (the old
     one-condition-per-poll design degraded to a 50 ms re-check loop for
     multi-partition assignments — wake-up jitter that landed directly in
-    the paced-p99 measurement)."""
+    the paced-p99 measurement).
 
-    __slots__ = ("records", "base_offset", "waiters")
+    Beside the record list the log keeps a running cumulative EVENT
+    count per record (`_ecum`, absolute from partition origin;
+    `_ebase` = events before records[0]), so event-weighted lag —
+    "how many EVENTS is this group behind", not "how many records" —
+    is O(1) per partition. Offset-counted lag under-reports a backlog
+    of columnar batches by the batch size (a 400k-event backlog of
+    1024-row batches reads as ~400), which starves anything scaling on
+    the signal."""
+
+    __slots__ = ("records", "base_offset", "waiters", "_ecum", "_ebase")
 
     def __init__(self) -> None:
         self.records: list[tuple[Optional[str], Any, float]] = []
         self.base_offset = 0  # offset of records[0]
         self.waiters: set[asyncio.Event] = set()
+        self._ecum: list[int] = []  # cumulative events through records[i]
+        self._ebase = 0             # events before records[0]
 
     @property
     def end_offset(self) -> int:
         return self.base_offset + len(self.records)
+
+    def append(self, key: Optional[str], value: Any) -> None:
+        self.records.append((key, value, time.time()))
+        prev = self._ecum[-1] if self._ecum else self._ebase
+        self._ecum.append(prev + _event_weight(value))
+
+    def events_ahead(self, committed: int) -> int:
+        """Events in records at offsets >= `committed` (event-weighted
+        lag for one partition)."""
+        if not self.records:
+            return 0
+        i = committed - self.base_offset
+        if i >= len(self.records):
+            return 0
+        floor = self._ebase if i <= 0 else self._ecum[i - 1]
+        return self._ecum[-1] - floor
 
     def notify(self) -> None:
         for w in self.waiters:
@@ -213,6 +253,8 @@ class _PartitionLog:
         if excess > 0:
             del self.records[:excess]
             self.base_offset += excess
+            self._ebase = self._ecum[excess - 1]
+            del self._ecum[:excess]
 
 
 class _Topic:
@@ -303,13 +345,21 @@ class EventBus(LifecycleComponent):
         self.create_topic(topic)
         return [p.end_offset for p in self._topics[topic].partitions]
 
-    def group_lags(self) -> dict[str, dict[str, int]]:
-        """Consumer lag per group: head offset minus committed offset,
-        summed per topic — the telemetry beat's backlog signal
-        (kernel/observe.py) and the input ROADMAP item 2's placement
-        controller scales replicas on. A partition a group never
-        committed counts its full retained backlog (earliest-reset
-        semantics: every retained record is still ahead of the group)."""
+    def group_lags(self, *, events: bool = False
+                   ) -> dict[str, dict[str, int]]:
+        """Consumer lag per group: head minus committed, summed per
+        topic — the telemetry beat's backlog signal (kernel/observe.py)
+        and the input ROADMAP item 2's placement controller scales
+        replicas on. A partition a group never committed counts its
+        full retained backlog (earliest-reset semantics: every retained
+        record is still ahead of the group).
+
+        `events=True` weights each record by the events it carries
+        (columnar batch rows) instead of counting offsets — the signal
+        anything SCALING on lag should read: a backlog of 1024-row
+        batches under-reports by 3 orders of magnitude in record units,
+        so a queue can grow without bound while offset-lag idles below
+        any threshold. O(1) per partition either way."""
         out: dict[str, dict[str, int]] = {}
         for group, state in self._groups.items():
             lags: dict[str, int] = {}
@@ -327,7 +377,10 @@ class EventBus(LifecycleComponent):
                 for p, log in enumerate(topic.partitions):
                     committed = state.committed.get((topic_name, p),
                                                     log.base_offset)
-                    total += max(log.end_offset - committed, 0)
+                    if events:
+                        total += log.events_ahead(committed)
+                    else:
+                        total += max(log.end_offset - committed, 0)
                 if total:
                     lags[topic_name] = total
             out[group] = lags
@@ -474,7 +527,7 @@ class EventBus(LifecycleComponent):
         p = partition if partition is not None else self._select_partition(topic, key)
         log = topic.partitions[p]
         offset = log.end_offset
-        log.records.append((key, value, time.time()))
+        log.append(key, value)
         log.trim(topic.retention)
         log.notify()
         return p, offset
@@ -495,7 +548,7 @@ class EventBus(LifecycleComponent):
         p = partition if partition is not None else self._select_partition(topic, key)
         log = topic.partitions[p]
         offset = log.end_offset
-        log.records.append((key, value, time.time()))
+        log.append(key, value)
         log.trim(topic.retention)
         try:
             asyncio.get_running_loop()

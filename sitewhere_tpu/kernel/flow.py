@@ -464,8 +464,18 @@ class FlowController:
         immediately. With a cap, callers queue in per-tenant DRR lanes
         and are granted in weighted-fair order as the shared budget
         refills — a hog tenant's backlog cannot starve its peers'
-        inbound loops."""
+        inbound loops.
+
+        The platform's reserved internal tenant (config.RESERVED_TENANT
+        — the fleet forecaster's tenant-0) bypasses the roster: its
+        scoring traffic is the control plane observing the fleet, and
+        queuing it behind customer lanes would starve exactly the
+        forecasts needed most when the fleet is saturated."""
         if self._inbound_bucket is None:
+            return
+        from sitewhere_tpu.config import RESERVED_TENANT
+
+        if tenant_id == RESERVED_TENANT:
             return
         if (self._fair.pending == 0 and self._fair_inflight == 0
                 and self._inbound_bucket.try_acquire(cost)):

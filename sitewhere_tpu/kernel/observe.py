@@ -66,16 +66,23 @@ def per_tenant_lags(lags: dict, roster=None) -> dict[str, int]:
     consumer groups are `{tenant}.{service}`; the control/observer
     plane's own groups live under the reserved first segment `fleet`
     (`fleet.controller`, `fleet.worker.*`, `fleet.observer.*`) — a
-    TENANT named e.g. `fleetops` still counts. Pass `roster` (the
-    known tenant ids — `ServiceRuntime.tenants` / the controller's
-    roster) to also drop NON-tenant groups that happen to contain a
-    dot (service-internal groups, meter groups): without it the first
-    segment is taken on faith. One implementation for the beat's
-    history appends and the FleetObserver's lag matrix."""
+    TENANT named e.g. `fleetops` still counts — and the platform's
+    reserved internal tenant (`config.RESERVED_TENANT`, the fleet
+    forecaster's tenant-0) is likewise dropped: its topics/groups are
+    the platform scoring itself, and counting them as customer load
+    would let the forecaster's own dispatch inflate the lag matrix it
+    forecasts from. Pass `roster` (the known tenant ids —
+    `ServiceRuntime.tenants` / the controller's roster) to also drop
+    NON-tenant groups that happen to contain a dot (service-internal
+    groups, meter groups): without it the first segment is taken on
+    faith. One implementation for the beat's history appends and the
+    FleetObserver's lag matrix."""
+    from sitewhere_tpu.config import RESERVED_TENANT
+
     out: dict[str, int] = {}
     for group, by_topic in lags.items():
         tid, _, rest = group.partition(".")
-        if not rest or tid == "fleet":
+        if not rest or tid == "fleet" or tid == RESERVED_TENANT:
             continue
         if roster is not None and tid not in roster:
             continue
@@ -135,6 +142,13 @@ class TelemetryBeat(BackgroundTaskComponent):
         self._export_stages_every = max(int(getattr(
             settings, "observe_export_stages_every", 8)), 1)
         self.exports = metrics.counter("observe.exports")
+        # accept-rate history series state: last-seen `flow.admitted`
+        # counter value + sample time per tenant, differenced into an
+        # events/sec series each beat (the predictive control plane's
+        # demand signal — lag tells you what's queued, accept rate
+        # tells you what's still arriving)
+        self._accept_last: dict[str, float] = {}
+        self._accept_t: Optional[float] = None
 
     async def _run(self) -> None:
         import asyncio
@@ -186,7 +200,13 @@ class TelemetryBeat(BackgroundTaskComponent):
         lags: dict[str, int] = {}
         group_lags = getattr(runtime.bus, "group_lags", None)
         if group_lags is not None and self._lags_local is not False:
-            lag_map = group_lags()
+            try:
+                # event-weighted (kernel/bus.py): the history series the
+                # predictive planner trains on and the autoscaler's bar
+                # must share units — events, not record offsets
+                lag_map = group_lags(events=True)
+            except TypeError:  # wire-proxied bus: record units only
+                lag_map = group_lags()
             if inspect.isawaitable(lag_map):
                 # wire bus: the broker process owns the committed/head
                 # view — sample lag there (fleet controller does)
@@ -296,6 +316,21 @@ class TelemetryBeat(BackgroundTaskComponent):
         for tid, s in scoring.items():
             history.append(tid, "scoring_pending",
                            float(s.get("pending", 0)), t=t)
+        # accept rate: per-tenant admitted-events/sec from the flow
+        # counters' between-beat deltas (a counter restart — worker
+        # respawn — shows as a negative delta and is clamped to 0; the
+        # window the restart gap leaves stays a genuine history hole)
+        metrics = self.runtime.metrics
+        prev_t = self._accept_t
+        self._accept_t = t
+        for tid in (roster or ()):
+            cur = float(metrics.counter(f"flow.admitted:{tid}").value)
+            last = self._accept_last.get(tid)
+            self._accept_last[tid] = cur
+            if last is None or prev_t is None or t <= prev_t:
+                continue
+            history.append(tid, "accept_rate",
+                           max(cur - last, 0.0) / (t - prev_t), t=t)
         history.append(self._worker_key(), "loop_lag_ms",
                        sample["loop_lag_ms"], t=t)
 

@@ -537,6 +537,16 @@ class ServiceRuntime(LifecycleComponent):
 
     async def add_tenant(self, tenant: TenantConfig, *, timeout: float = 60.0) -> None:
         """Register a tenant and broadcast creation (reference: §3.5)."""
+        from sitewhere_tpu.config import RESERVED_TENANT
+
+        if tenant.tenant_id == RESERVED_TENANT:
+            # the platform's own internal tenant (the fleet forecaster's
+            # tenant-0 scoring slot, fleet/forecast.py): it must never
+            # become a CUSTOMER tenant — placed on workers, counted in
+            # the lag matrix, admitted through the fair roster
+            raise ValueError(
+                f"tenant id {RESERVED_TENANT!r} is reserved for the "
+                "platform's internal scoring slot")
         self.tenants[tenant.tenant_id] = tenant
         self.flow.configure_tenant(tenant)
         self.tenant_epoch += 1

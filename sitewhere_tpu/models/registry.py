@@ -15,6 +15,10 @@ from sitewhere_tpu.models.lstm import (
     LstmConfig,
     StreamingLstmModel,
 )
+from sitewhere_tpu.models.seasonal import (
+    SeasonalTrendConfig,
+    SeasonalTrendForecaster,
+)
 from sitewhere_tpu.models.tft import TftConfig, TftForecaster
 from sitewhere_tpu.models.zscore import ZScoreConfig, ZScoreModel
 
@@ -24,6 +28,8 @@ MODEL_REGISTRY: dict[str, tuple[type, type]] = {
     "tft": (TftConfig, TftForecaster),
     "zscore": (ZScoreConfig, ZScoreModel),
     "longwin": (LongWindowConfig, LongWindowModel),
+    # the fleet's own load forecaster (fleet/forecast.py tenant-0)
+    "seasonal": (SeasonalTrendConfig, SeasonalTrendForecaster),
 }
 
 

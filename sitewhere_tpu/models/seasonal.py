@@ -1,0 +1,201 @@
+"""Seasonal-trend linear forecaster: the fleet's own load model.
+
+The predictive control plane (fleet/forecast.py, docs/FLEET.md) needs a
+forecaster that (a) extrapolates a load RAMP from a short context even
+when freshly initialized — the planner's value is pre-warming ahead of
+the ~13–19 s JAX spawn/first-compile horizon, and a cold persistence
+model would predict "flat" right when it matters — and (b) rides the
+shared megabatch pool unmodified, i.e. speaks the exact registry-model
+protocol every detector speaks (`init`, `score/loss(params, x[B, W],
+valid[B, W])`, static shapes, no Python branching on data).
+
+Structure (Holt-style level+trend with a learned residual head):
+
+- **structural half, parameter-free**: masked least-squares level and
+  slope over the context region of the normalized window; the base
+  forecast is `level + slope · h` — a zero-initialized model already
+  extrapolates trends correctly (the cold-start floor the confidence
+  gate's "model is cold" demotion backstops).
+- **learned half**: a linear read of the detrended context residuals
+  (`w · r`, one weight per context step) plus `harmonics` sin/cos
+  seasonal terms over window position, a trend gain and a bias —
+  trained by the ordinary `training/trainer.py` loop on history
+  windows (MSE over the horizon tail, masked by validity: gap windows
+  from worker restarts simply contribute no loss).
+
+`score` returns the predicted load at the horizon in ORIGINAL units
+(max over horizon steps, floored at 0), so the pool's per-tenant
+threshold doubles as the planner's scale-up bar and a `ScoredBatch`'s
+scores ARE the per-tenant forecasts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SeasonalTrendConfig:
+    window: int = 32           # total input length W (context + horizon)
+    horizon: int = 6           # forecast steps H
+    harmonics: int = 2         # seasonal sin/cos pairs over window position
+    min_history: int = 4       # valid context steps needed to forecast
+    score_clip: float = 1e9
+
+    @property
+    def context(self) -> int:
+        return self.window - self.horizon
+
+
+class SeasonalTrendForecaster:
+    """Functional model; params are an explicit pytree (vmap/pjit
+    contract shared with the rest of the zoo — the TenantStack stacks
+    these leaves per tenant slot exactly like the detectors')."""
+
+    name = "seasonal"
+
+    def __init__(self, cfg: SeasonalTrendConfig = SeasonalTrendConfig()):
+        if cfg.horizon >= cfg.window:
+            raise ValueError("horizon must be < window")
+        if cfg.horizon < 1:
+            raise ValueError("horizon must be >= 1")
+        self.cfg = cfg
+
+    # -- params --------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        del rng  # zero init IS the model: the structural half already
+        #          forecasts; training only learns corrections
+        return {
+            "w": jnp.zeros((cfg.context,), jnp.float32),
+            "season": jnp.zeros((2 * cfg.harmonics,), jnp.float32),
+            "gain": jnp.ones((), jnp.float32),
+            "bias": jnp.zeros((), jnp.float32),
+        }
+
+    # -- structural pieces ---------------------------------------------------
+
+    def _normalize(self, x, valid):
+        """Masked mean/std over the CONTEXT region only (the horizon
+        tail is the training target; its stats must not leak)."""
+        cfg = self.cfg
+        v = valid[:, :cfg.context].astype(jnp.float32)
+        xc = x[:, :cfg.context]
+        n = jnp.maximum(v.sum(-1, keepdims=True), 1.0)
+        mu = (xc * v).sum(-1, keepdims=True) / n
+        var = (((xc - mu) * v) ** 2).sum(-1, keepdims=True) / n
+        sd = jnp.sqrt(var + 1e-6)
+        return (x - mu) / sd, mu, sd
+
+    def _level_slope(self, xn, valid):
+        """Masked DISCOUNTED least-squares level (value at the last
+        context step) and per-step slope over the valid context points,
+        with exponentially decaying weights (newest step weight 1):
+        unweighted LS over the whole context dilutes a ramp ONSET — a
+        flat 25-step lead-in drags the fitted slope of 3 rising tail
+        steps toward zero, and the "forecast" only crosses a scale-up
+        bar after the realized load does, which is no forecast at all.
+        Discounting keeps an effective memory of ~1/(1-γ) steps while
+        still fitting an established linear ramp exactly (weighted LS
+        on exact lines is exact). Gap windows (restart holes) just drop
+        out of the sums; < 2 effective points pins the slope to 0
+        (level-only forecast)."""
+        cfg = self.cfg
+        c = cfg.context
+        gamma = 0.85
+        decay = gamma ** jnp.arange(c - 1, -1, -1, dtype=jnp.float32)
+        v = valid[:, :c].astype(jnp.float32) * decay[None, :]
+        xc = xn[:, :c]
+        t = jnp.arange(c, dtype=jnp.float32)[None, :]
+        n = jnp.maximum(v.sum(-1), 1.0)
+        tm = (t * v).sum(-1) / n
+        xm = (xc * v).sum(-1) / n
+        dt = (t - tm[:, None]) * v
+        cov = (dt * (xc - xm[:, None])).sum(-1) / n
+        var = (dt * dt).sum(-1) / n
+        slope = jnp.where(var > 1e-9, cov / jnp.maximum(var, 1e-9), 0.0)
+        slope = jnp.where(v.sum(-1) >= 2.0, slope, 0.0)
+        level = xm + slope * (c - 1.0 - tm)
+        return level, slope, v
+
+    def _predict_norm(self, params, xn, valid):
+        """Forecast of the horizon steps in NORMALIZED units: [B, H]."""
+        cfg = self.cfg
+        c, h = cfg.context, cfg.horizon
+        level, slope, v = self._level_slope(xn, valid)
+        steps = jnp.arange(1, h + 1, dtype=jnp.float32)[None, :]
+        base = level[:, None] + slope[:, None] * steps          # [B, H]
+        # learned residual read over the detrended context
+        t = jnp.arange(c, dtype=jnp.float32)[None, :]
+        fit = level[:, None] + slope[:, None] * (t - (c - 1.0))
+        resid = (xn[:, :c] - fit) * v                           # [B, C]
+        corr = resid @ params["w"]                              # [B]
+        # seasonal harmonics over absolute window position
+        pos = (c - 1.0 + steps) / cfg.window                    # [1, H]
+        ks = jnp.arange(1, cfg.harmonics + 1, dtype=jnp.float32)
+        ang = 2.0 * jnp.pi * ks[:, None] * pos                  # [K, 1H]
+        seas = (params["season"][:cfg.harmonics] @ jnp.sin(ang)
+                + params["season"][cfg.harmonics:] @ jnp.cos(ang))  # [H]
+        return params["gain"] * base + params["bias"] \
+            + corr[:, None] + seas[None, :]
+
+    # -- public API ----------------------------------------------------------
+
+    def forecast(self, params: dict, x: jax.Array,
+                 valid: jax.Array) -> jax.Array:
+        """Horizon forecast in ORIGINAL units: [B, H]."""
+        xn, mu, sd = self._normalize(x, valid)
+        return self._predict_norm(params, xn, valid) * sd + mu
+
+    def score(self, params: dict, x: jax.Array,
+              valid: jax.Array) -> jax.Array:
+        """Predicted load at the horizon BEYOND the newest observed
+        step, original units: the max over horizon steps, floored at 0
+        (load is non-negative). The serving ring hands the LAST W
+        observed points (newest at W-1), so the window is shifted to
+        put the newest `context` steps in the context region and the
+        horizon extrapolates past the end of the data — without the
+        shift the context would be `horizon` steps stale and the
+        "forecast" would collapse to the current load. Windows with
+        fewer than `min_history` valid context steps score 0 — "no
+        forecast", which the planner's thin-history gate also catches
+        upstream. x: [B, W], valid: [B, W] → [B]."""
+        cfg = self.cfg
+        h = cfg.horizon
+        xs = jnp.concatenate([x[:, h:], jnp.zeros_like(x[:, :h])], axis=-1)
+        vs = jnp.concatenate(
+            [valid[:, h:], jnp.zeros_like(valid[:, :h])], axis=-1)
+        pred = self.forecast(params, xs, vs).max(axis=-1)
+        enough = vs[:, :cfg.context].sum(-1) >= cfg.min_history
+        return jnp.clip(jnp.where(enough, pred, 0.0), 0.0, cfg.score_clip)
+
+    def loss(self, params: dict, x: jax.Array,
+             valid: jax.Array) -> jax.Array:
+        """Masked HUBER loss between the context-only forecast and the
+        realized horizon tail, in normalized units. Huber, not MSE:
+        normalization uses context-only stats, so a near-flat context
+        before a load spike puts the horizon tail thousands of sigmas
+        out — squared error there hands the optimizer unbounded
+        gradients and the params diverge to inf (observed: a
+        calibration-flood window next to a quiet seed window). Huber
+        caps the gradient at delta per point; trend extrapolation is
+        carried by the parameter-free structural half regardless."""
+        cfg = self.cfg
+        delta = 3.0
+        xn, _, _ = self._normalize(x, valid)
+        pred = self._predict_norm(params, xn, valid)
+        y = xn[:, cfg.context:]
+        vt = valid[:, cfg.context:].astype(jnp.float32)
+        err = jnp.abs(pred - y)
+        hub = jnp.where(err <= delta, 0.5 * err * err,
+                        delta * (err - 0.5 * delta))
+        return (hub * vt).sum() / jnp.maximum(vt.sum(), 1.0)
+
+    def flops_per_event(self) -> float:
+        """A few fused vector ops over the window — negligible next to
+        the detectors, but non-zero so throughput accounting works."""
+        return float(8 * self.cfg.window)

@@ -338,6 +338,11 @@ class RestServer(LifecycleComponent):
         # fleet control plane (sitewhere_tpu/fleet): placement epoch,
         # worker liveness, autoscaler decisions — `swx fleet status`
         r("GET", r"/api/fleet", self.get_fleet)
+        # predictive control plane (fleet/forecast.py): per-tenant load
+        # forecasts off the tenant-0 slot, the confidence gate's state,
+        # and the deployed forecaster version — `swx top --fleet`'s
+        # forecast rows
+        r("GET", r"/api/fleet/forecast", self.get_fleet_forecast)
         # fleet observability plane (fleet/observer.py): the merged
         # per-worker beat view — fleet critical path, lag matrix, mesh
         # occupancy, broker stats — `swx top --fleet`'s data source,
@@ -546,6 +551,19 @@ class RestServer(LifecycleComponent):
         broker = stats_fn() if callable(stats_fn) else None
         snap["broker"] = broker if isinstance(broker, dict) else None
         return snap
+
+    async def get_fleet_forecast(self, req: Request):
+        """Predictive-planner state (fleet/forecast.py): live per-tenant
+        load forecasts at the horizon, gate/demotion status, horizon
+        error EMA, deployed model version, and the last train report."""
+        fleet = getattr(self.runtime, "fleet", None)
+        if fleet is None:
+            raise HttpError(404, "no fleet controller in this process")
+        planner = getattr(fleet, "planner", None)
+        if planner is None:
+            raise HttpError(404, "predictive planner not running "
+                            "(fleet_forecast off or no telemetry history)")
+        return planner.snapshot()
 
     def _fleet_observer(self):
         observer = getattr(self.runtime, "fleet_observer", None)

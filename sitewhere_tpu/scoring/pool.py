@@ -133,6 +133,12 @@ class _TenantEntry:
                         BatchContext, float]] = field(default_factory=list)
     pending_n: int = 0
     inflight: int = 0          # this tenant's share of in-flight flushes
+    # reserved platform tenant (config.RESERVED_TENANT — the fleet
+    # forecaster's tenant-0 slot): scores through the same megabatch
+    # path but must not count as CUSTOMER traffic in the adaptive
+    # window tuner's active-tenant view (its once-per-window cadence
+    # would drag occupancy down and widen the window for everyone)
+    internal: bool = False
 
 
 class TenantSlot:
@@ -394,12 +400,13 @@ class SharedScoringPool:
 
     def register(self, tenant_id: str, telemetry: TelemetryStore,
                  threshold: float, deliver: Deliver,
-                 params: Optional[dict] = None) -> TenantSlot:
+                 params: Optional[dict] = None,
+                 internal: bool = False) -> TenantSlot:
         if tenant_id in self.tenants:
             raise ValueError(f"tenant {tenant_id!r} already registered")
         slot = self.stack.add_tenant(tenant_id, params)
         self.tenants[tenant_id] = _TenantEntry(
-            tenant_id, telemetry, threshold, deliver)
+            tenant_id, telemetry, threshold, deliver, internal=internal)
         host = telemetry.channels.get(self.cfg.mtype)
         host_cap = host.capacity if host is not None else 1024
         if self.ring is None:
@@ -553,10 +560,12 @@ class SharedScoringPool:
             return
         now = time.monotonic()
         self.stage_admit.observe(now - batch.ctx.ingest_monotonic)
-        if self.cfg.window_auto:
-            # window tuner: live traffic (guarded — with the tuner off
-            # _tune_window never reaches its periodic clear, and the
-            # set would grow without bound under tenant churn)
+        if self.cfg.window_auto and not entry.internal:
+            # window tuner: live CUSTOMER traffic (guarded — with the
+            # tuner off _tune_window never reaches its periodic clear,
+            # and the set would grow without bound under tenant churn;
+            # internal slots like tenant-0 admit on their own cadence
+            # and must not count as aggregatable load)
             self._tuner_tenants.add(tenant_id)
         ingest = np.full(dev.shape[0], batch.ctx.ingest_monotonic)
         entry.pending.append((dev, val, ts, ingest, batch.ctx, now))

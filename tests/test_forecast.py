@@ -1,0 +1,304 @@
+"""Predictive control plane (fleet/forecast.py): history→feature-window
+edge cases, tenant-0 serving through the shared pool, and the planner's
+confidence gate / forecast-attributed decisions."""
+
+import asyncio
+import math
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.config import RESERVED_TENANT, InstanceSettings
+from sitewhere_tpu.fleet.controller import AutoscalerPolicy
+from sitewhere_tpu.fleet.forecast import (
+    LOAD_SIGNALS,
+    FeaturePipeline,
+    PredictivePlanner,
+)
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+from sitewhere_tpu.kernel.observe import per_tenant_lags
+from sitewhere_tpu.persistence.durable import TelemetryHistory
+
+WS = 1.0
+
+
+def make_history(tmp_path, name="hist", window_s=WS):
+    return TelemetryHistory(str(tmp_path / name), window_s=window_s)
+
+
+def fill_ramp(h, tenant, t0, n, *, slope=100.0, gap=()):
+    """n windows of a lag ramp, skipping the window indices in `gap`
+    (a worker restart: the beat simply wrote nothing)."""
+    for i in range(n):
+        if i in gap:
+            continue
+        h.append(tenant, "lag", slope * i, t=t0 + i * WS + 0.25)
+        h.append(tenant, "lag", slope * i, t=t0 + i * WS + 0.75)
+
+
+# -- feature pipeline edge cases ---------------------------------------------
+
+
+def test_restart_gap_windows_are_invalid_not_zero(tmp_path):
+    h = make_history(tmp_path)
+    t0 = math.floor(time.time() / WS) * WS - 40 * WS
+    fill_ramp(h, "acme", t0, 20, gap=(7, 8))
+    h.flush()
+    fp = FeaturePipeline(h)
+    vals, valid, starts = fp.load_series("acme", window=20,
+                                         until=t0 + 20 * WS)
+    assert starts[0] == t0
+    assert not valid[7] and not valid[8]
+    assert valid[6] and valid[9]
+    # the gap must be masked, not silently zero-valued "load vanished"
+    assert vals[9] == pytest.approx(900.0)
+    # and the gap mask rides into the training windows
+    w, wv = fp.training_windows(["acme"], 12, until=t0 + 20 * WS)
+    assert w.shape[0] > 0
+    assert (~wv).any()
+
+
+def test_open_live_tail_window_is_readable(tmp_path):
+    h = make_history(tmp_path)
+    open_w = math.floor(time.time() / WS) * WS
+    t0 = open_w - 5 * WS
+    fill_ramp(h, "acme", t0, 5)
+    # the OPEN window: appended, never flushed — the live tail must
+    # still resolve onto the grid when `until` reaches past it
+    h.append("acme", "lag", 999.0, t=open_w + 0.1)
+    fp = FeaturePipeline(h)
+    vals, valid, starts = fp.load_series("acme", window=6,
+                                         until=open_w + WS)
+    assert starts[-1] == open_w
+    assert valid[-1] and vals[-1] == pytest.approx(999.0)
+    # serving-grid semantics: until at the open window START excludes it
+    vals2, valid2, starts2 = fp.load_series("acme", window=5, until=open_w)
+    assert starts2[-1] == open_w - WS
+
+
+def test_flush_split_rows_merge_to_one_window_mean(tmp_path):
+    h = make_history(tmp_path)
+    w0 = math.floor(time.time() / WS) * WS - 10 * WS
+    h.append("acme", "lag", 100.0, t=w0 + 0.2)
+    h.flush()  # closes the open window: the next append SPLITS the row
+    h.append("acme", "lag", 300.0, t=w0 + 0.8)
+    h.flush()
+    fp = FeaturePipeline(h)
+    vals, valid, starts = fp.load_series("acme", window=1, until=w0 + WS)
+    assert valid[0]
+    # merged at read: mean over BOTH rows' points, not either alone
+    assert vals[0] == pytest.approx(200.0)
+
+
+def test_since_until_boundary_semantics_on_grid(tmp_path):
+    h = make_history(tmp_path)
+    w0 = math.floor(time.time() / WS) * WS - 20 * WS
+    fill_ramp(h, "acme", w0, 10)
+    h.flush()
+    fp = FeaturePipeline(h)
+    # until is EXCLUSIVE on window start: a grid ending at until=w0+5
+    # must not contain the window starting at w0+5
+    vals, valid, starts = fp.load_series("acme", window=5, until=w0 + 5 * WS)
+    assert starts[0] == w0 and starts[-1] == w0 + 4 * WS
+    assert valid.all()
+    assert vals[-1] == pytest.approx(400.0)
+    # and exactly n windows come back for an n-window span
+    x, v, s = fp.features(["acme"], window=10, until=w0 + 10 * WS)
+    assert x.shape == (1, 10, len(fp.signals))
+    li = fp.signals.index("lag")
+    assert v[0, :, li].all()
+
+
+def test_restart_survival_feeds_feature_builder(tmp_path):
+    """History written before a 'restart' (new TelemetryHistory over the
+    same directory) must still resolve on the same grid afterwards."""
+    t0 = math.floor(time.time() / WS) * WS - 30 * WS
+    h = make_history(tmp_path, "h")
+    fill_ramp(h, "acme", t0, 10)
+    h.close()  # process death; closed rows are on disk
+    h2 = TelemetryHistory(str(tmp_path / "h"), window_s=WS)
+    fill_ramp(h2, "acme", t0 + 14 * WS, 6, slope=50.0)
+    h2.flush()
+    fp = FeaturePipeline(h2)
+    vals, valid, starts = fp.load_series("acme", window=20,
+                                         until=t0 + 20 * WS)
+    assert valid[:10].all()          # pre-restart windows replayed
+    assert not valid[10:14].any()    # the downtime hole stays a hole
+    assert valid[14:].all()
+    h2.close()
+
+
+# -- reserved tenant-0 roster rules ------------------------------------------
+
+
+def test_per_tenant_lags_drops_reserved_tenant():
+    lags = {
+        "acme.inbound": {"t": 5},
+        f"{RESERVED_TENANT}.inbound": {"t": 50},
+        "fleet.controller": {"t": 9},
+    }
+    out = per_tenant_lags(lags)
+    assert out == {"acme": 5}
+
+
+def test_admit_fair_bypasses_reserved_tenant(run):
+    from sitewhere_tpu.kernel.flow import FlowController
+
+    settings = InstanceSettings(flow_inbound_rate=1.0)
+    flow = FlowController(settings=settings, metrics=MetricsRegistry())
+
+    async def main():
+        # the shared budget is 1 ev/s: a customer admit would queue,
+        # the platform's own slot must not
+        t0 = time.monotonic()
+        for _ in range(20):
+            await flow.admit_fair(RESERVED_TENANT, cost=5.0)
+        assert time.monotonic() - t0 < 0.5
+
+    run(main())
+
+
+def test_add_tenant_rejects_reserved_id(run):
+    from sitewhere_tpu.config import TenantConfig
+    from sitewhere_tpu.kernel.service import ServiceRuntime
+
+    async def main():
+        runtime = ServiceRuntime(InstanceSettings(
+            instance_id="test", observe_enabled=False))
+        await runtime.start()
+        try:
+            with pytest.raises(ValueError, match="reserved"):
+                await runtime.add_tenant(
+                    TenantConfig(tenant_id=RESERVED_TENANT))
+        finally:
+            await runtime.stop()
+
+    run(main())
+
+
+# -- the planner -------------------------------------------------------------
+
+
+def make_controller(tmp_path, history, **policy_kw):
+    settings = InstanceSettings(
+        data_dir=str(tmp_path / "data"),
+        fleet_forecast_window=16,
+        fleet_forecast_horizon_s=4.0,
+        fleet_forecast_interval_s=0.0,
+        fleet_forecast_min_windows=6,
+        fleet_forecast_max_stale_s=30.0,
+    )
+    runtime = SimpleNamespace(settings=settings, metrics=MetricsRegistry(),
+                              history=history, tracer=None, faults=None)
+    policy = AutoscalerPolicy(**{"scale_up_lag": 300.0, "cooldown_s": 0.0,
+                                 **policy_kw})
+    return SimpleNamespace(runtime=runtime, policy=policy,
+                           tenants={"acme": object(), "beta": object()},
+                           _last_scale_t=-1e9, _pending_spawns=0)
+
+
+def test_cold_start_demotes_to_reactive(tmp_path):
+    h = make_history(tmp_path)
+    t0 = math.floor(time.time() / WS) * WS - 30 * WS
+    fill_ramp(h, "acme", t0, 20)
+    h.flush()
+    c = make_controller(tmp_path, h)
+    planner = PredictivePlanner(c)
+    # cold: serving never started, nothing trained → pure-reactive,
+    # demotion counted ONCE (transition), not once per gated tick
+    assert planner.decide({"w1": 0.0}, {}) is None
+    assert planner.decide({"w1": 0.0}, {}) is None
+    assert planner.demotions_c.value == 1
+    assert "not started" in planner.snapshot()["gate"]
+    h.close()
+
+
+def test_trains_from_history_and_emits_forecast_decision(tmp_path):
+    """The tier-1 story end to end: synthetic ramp history → trainer →
+    tenant-0 slot through the shared pool → one forecast-attributed
+    add_replica out of decide()."""
+    h = make_history(tmp_path)
+    now_w = math.floor(time.time() / WS) * WS
+    t0 = now_w - 60 * WS
+    for tid in ("acme", "beta"):
+        fill_ramp(h, tid, t0, 58, slope=40.0)
+    h.flush()
+    c = make_controller(tmp_path, h)
+    planner = PredictivePlanner(c)
+    report = planner.train_from_history(steps=25)
+    assert report is not None and report["version"] >= 1
+    assert planner.trainings_c.value == 1
+
+    async def run():
+        await planner.tick()   # starts serving, backfills, registers
+        deadline = time.monotonic() + 30.0
+        while not planner.forecasts and time.monotonic() < deadline:
+            # keep the ramp alive so newly CLOSED windows keep arriving
+            wall = time.time()
+            i = (wall - t0) / WS
+            for tid in ("acme", "beta"):
+                h.append(tid, "lag", 40.0 * i, t=wall)
+            await planner.tick()
+            await asyncio.sleep(0.25)
+        return planner.decide({"w1": 1.0}, {})
+
+    decision = asyncio.run(run())
+    try:
+        assert planner.forecasts, "no forecast settled through the pool"
+        assert decision is not None, planner.snapshot()
+        assert decision["action"] == "add_replica"
+        assert decision["reason"].startswith("forecast:")
+        prov = decision["forecast"]
+        assert prov["horizon_s"] == pytest.approx(4.0)
+        assert prov["predicted_load"] > 0
+        assert planner.decisions_c.value == 1
+        # the pool path really served it: tenant-0 is a registered slot
+        assert RESERVED_TENANT in planner.pool.tenants
+        assert planner.snapshot()["gate"] == "ok"
+    finally:
+        planner.close()
+        h.close()
+
+
+def test_stale_forecast_regates(tmp_path):
+    h = make_history(tmp_path)
+    t0 = math.floor(time.time() / WS) * WS - 30 * WS
+    fill_ramp(h, "acme", t0, 28)
+    h.flush()
+    c = make_controller(tmp_path, h)
+    planner = PredictivePlanner(c)
+    planner._trained = True
+    planner.pool = object()  # serving "up" for the gate's purposes
+    planner.slot = object()
+    planner.forecasts["acme"] = {
+        "load": 1e6, "made_t": time.time() - 100,
+        "made_monotonic": time.monotonic() - 100.0, "model_version": 1}
+    assert planner.decide({"w1": 0.0}, {}) is None
+    assert "no fresh forecast" in planner.snapshot()["gate"]
+    # freshen it: the same forecast now drives a decision
+    planner.forecasts["acme"]["made_monotonic"] = time.monotonic()
+    d = planner.decide({"w1": 0.0}, {})
+    assert d is not None and "forecast" in d
+    h.close()
+
+
+def test_high_horizon_error_demotes(tmp_path):
+    h = make_history(tmp_path)
+    t0 = math.floor(time.time() / WS) * WS - 30 * WS
+    fill_ramp(h, "acme", t0, 28)
+    h.flush()
+    c = make_controller(tmp_path, h)
+    planner = PredictivePlanner(c)
+    planner._trained = True
+    planner.pool = object()
+    planner.slot = object()
+    planner.forecasts["acme"] = {
+        "load": 1e6, "made_t": time.time(),
+        "made_monotonic": time.monotonic(), "model_version": 1}
+    planner.error_ema = planner.error_gate * 2
+    assert planner.decide({"w1": 0.0}, {}) is None
+    assert "horizon error" in planner.snapshot()["gate"]
+    assert planner.demotions_c.value == 1
+    h.close()
