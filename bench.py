@@ -165,16 +165,29 @@ def probe_backend_supervised(horizon_s: float = 600.0,
 
 def _lint_summary():
     """Static-analysis health stamped into every artifact: new/baselined
-    swxlint finding counts (sitewhere_tpu/analysis). A rising `new`
-    count across rounds is a contract regression the trajectory should
-    show, exactly like a throughput drop. Never fails the bench."""
+    swxlint finding counts (sitewhere_tpu/analysis), per-code, plus each
+    checker's wall time. A rising `new` count across rounds is a
+    contract regression the trajectory should show, exactly like a
+    throughput drop — and a checker whose timing column balloons is a
+    lint-latency regression the 10s budget gates. Never fails the
+    bench."""
     try:
         from sitewhere_tpu.analysis import lint_package
 
         report = lint_package()
+        per_code: dict = {}
+        for f in report.findings:
+            per_code.setdefault(f.code, {"new": 0, "baselined": 0})
+            per_code[f.code]["new"] += 1
+        for f, _reason in report.baselined:
+            per_code.setdefault(f.code, {"new": 0, "baselined": 0})
+            per_code[f.code]["baselined"] += 1
         return {"new": len(report.findings),
                 "baselined": len(report.baselined),
-                "suppressed": len(report.suppressed)}
+                "suppressed": len(report.suppressed),
+                "by_code": per_code,
+                "timings_s": {c: round(t, 4)
+                              for c, t in sorted(report.timings.items())}}
     except Exception as exc:  # noqa: BLE001 - the artifact must still parse
         return {"error": f"{type(exc).__name__}: {exc}"}
 

@@ -16,8 +16,27 @@ cd "$(dirname "$0")/.."
 python -m compileall -q sitewhere_tpu || exit 1
 
 # `swx lint --format json` without the CLI entrypoint dependency; the
-# JSON report is the CI artifact (exit 1 = new findings, see output)
-python -m sitewhere_tpu.analysis --format json || { echo "swxlint: new findings (see JSON above; docs/ANALYSIS.md)"; exit 1; }
+# JSON report is the CI artifact (exit 1 = new findings or stale
+# baseline entries, see output), and the per-code summary below is the
+# one-line gate digest reviewers read
+python -m sitewhere_tpu.analysis --format json > /tmp/_swxlint.json || { cat /tmp/_swxlint.json; echo "swxlint: new findings or stale baseline (see JSON above; docs/ANALYSIS.md)"; exit 1; }
+python - <<'PY' || exit 1
+import json
+d = json.load(open("/tmp/_swxlint.json"))
+per = {}
+for kind in ("findings", "baselined", "suppressed"):
+    for f in d[kind]:
+        per.setdefault(f["code"], dict.fromkeys(
+            ("findings", "baselined", "suppressed"), 0))[kind] += 1
+cols = "  ".join(
+    f"{code}:{c['findings']}/{c['baselined']}/{c['suppressed']}"
+    for code, c in sorted(per.items())) or "all codes clean"
+total = sum(d["timings_s"].values())
+slowest = max(d["timings_s"].items(), key=lambda kv: kv[1])
+print(f"swxlint per-code (new/baselined/suppressed): {cols}")
+print(f"swxlint timings: {total:.2f}s total, slowest "
+      f"{slowest[0]}={slowest[1]:.2f}s over {d['checked_files']} files")
+PY
 
 # forced-multi-device smoke (docs/PERFORMANCE.md mesh serving): a REAL
 # 8-device {data: 4, model: 2} host-platform mesh must shard the

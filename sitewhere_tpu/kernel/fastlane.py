@@ -413,7 +413,14 @@ class FastLane(BackgroundTaskComponent):
                 # consumer sees this batch again at the enriched hop
                 # (hooks, deferred replay) and must not re-admit it
                 batch.ctx.fastlane = True
-                await runtime.bus.produce(self._inbound_topic, batch,
+                # CAN01-disabled: this lane's frontier is BATCH-granular
+                # (`delivered_positions()` advances only after the whole
+                # poll batch handled), so a cancel inside this produce
+                # leaves the frontier before the record — the stop path
+                # never commits past it and the adopter redelivers: the
+                # at-least-once side is chosen deliberately (the fused
+                # lane re-validates idempotently on replay)
+                await runtime.bus.produce(self._inbound_topic, batch,  # swxlint: disable=CAN01
                                           key=record.key,
                                           fence=engine.fence_token())
                 if sink is not None and isinstance(batch, MeasurementBatch):
@@ -430,8 +437,11 @@ class FastLane(BackgroundTaskComponent):
                 t_span, time.monotonic() - t_span, len(batch))
         elif isinstance(batch, RegistrationBatch):
             # registration stays on the staged path: hand it to the
-            # device-registration consumer exactly like the slow lane
-            await runtime.bus.produce(self._unregistered_topic, batch,
+            # device-registration consumer exactly like the slow lane.
+            # CAN01-disabled: same batch-granular frontier rationale as
+            # the inbound produce above — a cancel here redelivers the
+            # record, and registration is idempotent on replay
+            await runtime.bus.produce(self._unregistered_topic, batch,  # swxlint: disable=CAN01
                                       fence=engine.fence_token())
         else:
             logger.warning("fastlane: unknown record %r", type(batch))

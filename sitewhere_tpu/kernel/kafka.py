@@ -56,6 +56,7 @@ class KafkaEventBus:
         self.client_id = client_id
         self._producer = None
         self._consumers: list["KafkaBusConsumer"] = []
+        self._bg: set = set()  # strong refs: the loop keeps only weak ones
 
     # lifecycle stand-ins (ServiceRuntime treats the bus as a child)
     async def initialize(self) -> None:
@@ -72,6 +73,10 @@ class KafkaEventBus:
     async def stop(self) -> None:
         for consumer in list(self._consumers):
             await consumer.aclose()
+        if self._bg:
+            # settle in-flight fire-and-forget produces before the
+            # producer goes away (each one logs its own failure)
+            await asyncio.gather(*list(self._bg), return_exceptions=True)
         if self._producer is not None:
             await self._producer.stop()
             self._producer = None
@@ -89,8 +94,8 @@ class KafkaEventBus:
     def produce_nowait(self, topic: str, value: Any, *,
                        key: Optional[str] = None,
                        partition: Optional[int] = None) -> None:
-        asyncio.get_running_loop().create_task(
-            self.produce(topic, value, key=key, partition=partition))
+        _spawn_logged(self._bg, self.produce(topic, value, key=key,
+                                             partition=partition))
 
     def subscribe(self, topics: Iterable[str] | str, *, group: str,
                   name: Optional[str] = None) -> "KafkaBusConsumer":
@@ -113,6 +118,7 @@ class KafkaBusConsumer:
         self.name = name
         self._consumer = None
         self._closed = False
+        self._bg: set = set()  # strong refs: the loop keeps only weak ones
 
     async def _ensure(self) -> None:
         if self._consumer is None:
@@ -149,7 +155,7 @@ class KafkaBusConsumer:
             coro = self._consumer.commit(offsets)
         else:
             coro = self._consumer.commit()
-        asyncio.get_running_loop().create_task(_log_failure(coro))
+        _spawn_logged(self._bg, coro)
 
     def snapshot_positions(self):
         return self._snapshot()
@@ -163,12 +169,16 @@ class KafkaBusConsumer:
 
     def seek_to_beginning(self) -> None:
         if self._consumer is not None:
-            asyncio.get_running_loop().create_task(
-                _log_failure(self._consumer.seek_to_beginning()))
+            _spawn_logged(self._bg, self._consumer.seek_to_beginning())
 
     async def aclose(self) -> None:
         if not self._closed:
             self._closed = True
+            if self._bg:
+                # settle in-flight commits/seeks before the consumer
+                # stops (each one logs its own failure)
+                await asyncio.gather(*list(self._bg),
+                                     return_exceptions=True)
             if self._consumer is not None:
                 await self._consumer.stop()
 
@@ -176,8 +186,7 @@ class KafkaBusConsumer:
         if not self._closed:
             self._closed = True
             if self._consumer is not None:
-                asyncio.get_running_loop().create_task(
-                    _log_failure(self._consumer.stop()))
+                _spawn_logged(self._bg, self._consumer.stop())
 
 
 async def _log_failure(coro) -> None:
@@ -185,3 +194,14 @@ async def _log_failure(coro) -> None:
         await coro
     except Exception:  # noqa: BLE001 - background kafka op
         logger.exception("kafka background operation failed")
+
+
+def _spawn_logged(tasks: set, coro) -> "asyncio.Task":
+    """Retained fire-and-forget: the task set holds the strong reference
+    the event loop does not (an unretained task can be GC'd mid-flight —
+    swx lint TSK01), and the `_log_failure` wrapper retrieves the result
+    so a failed background op surfaces in the log instead of nowhere."""
+    task = asyncio.get_running_loop().create_task(_log_failure(coro))
+    tasks.add(task)
+    task.add_done_callback(tasks.discard)
+    return task

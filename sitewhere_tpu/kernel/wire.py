@@ -793,7 +793,19 @@ class BusServer(WireServer):
             st.task = asyncio.get_running_loop().create_task(
                 self._push_loop(cid, consumer, writer, st),
                 name=f"wire-push-{cid}")
+            # supervise: _push_loop handles the expected failure modes,
+            # but an unexpected escape would otherwise die silently and
+            # wedge this consumer's prefetch credit — the client keeps
+            # waiting for pushes that will never come
+            st.task.add_done_callback(self._push_loop_done)
         return cid
+
+    @staticmethod
+    def _push_loop_done(task: asyncio.Task) -> None:
+        if not task.cancelled() and task.exception() is not None:
+            logger.error("wire push loop %s died unexpectedly — the "
+                         "consumer's prefetch stream is wedged",
+                         task.get_name(), exc_info=task.exception())
 
     def _push_frame(self, writer: asyncio.StreamWriter, msg: dict) -> None:
         writer.writelines(_frame(0, msg))

@@ -274,6 +274,10 @@ class SharedScoringPool:
         self.dispatch_count = 0
         self.settled_count = 0
         self._outstanding: set[int] = set()   # dispatched, not yet settled
+        # strong refs to in-flight settle tasks: the loop keeps only
+        # weak ones, and a GC'd settle leaves `inflight`/`_outstanding`
+        # permanently stuck — the megabatch round never completes again
+        self._settle_tasks: set = set()
         self._pending_max = -1     # highest device index waiting
         self._wake = asyncio.Event()
         self._deadline: Optional[float] = None
@@ -898,8 +902,19 @@ class SharedScoringPool:
             e = self.tenants.get(tid)
             if e is not None:
                 e.inflight += 1
-        asyncio.get_running_loop().create_task(
+        task = asyncio.get_running_loop().create_task(
             self._settle_and_deliver(dispatches, metas, t0, seq))
+        self._settle_tasks.add(task)
+        task.add_done_callback(self._settle_task_done)
+
+    def _settle_task_done(self, task) -> None:
+        self._settle_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            # _settle_and_deliver's finally keeps the inflight
+            # accounting correct even here, but an escape is a bug —
+            # surface it instead of leaving the exception unretrieved
+            logger.error("pool settle task died unexpectedly",
+                         exc_info=task.exception())
 
     async def _settle_and_deliver(self, dispatches, metas, t0: float,
                                   seq: Optional[int] = None) -> None:

@@ -136,6 +136,10 @@ class ScoringSession:
         self.dispatch_count = 0
         self.settled_count = 0
         self._outstanding: set[int] = set()   # dispatched, not yet settled
+        # strong refs to in-flight settle tasks: the loop keeps only
+        # weak ones, and a GC'd settle leaves `inflight`/`_outstanding`
+        # permanently stuck — the session never flushes again
+        self._settle_tasks: set = set()
         self._regrow_task: Optional[asyncio.Task] = None
         # pending admission state:
         # (device_index, value, ts, ingest, ctx, admit_monotonic)
@@ -627,14 +631,25 @@ class ScoringSession:
             fut = loop.create_future() if futs is not None else None
             if fut is not None:
                 futs.append(fut)
-            loop.create_task(self._settle_and_deliver(
+            task = loop.create_task(self._settle_and_deliver(
                 dispatches, dev[lo:hi], ts[lo:hi],
                 ingest[lo:hi], ctx, t0, fut, seq,
                 traces if lo == 0 else None))
+            self._settle_tasks.add(task)
+            task.add_done_callback(self._settle_task_done)
             n_chunks += 1
         else:
             return n_chunks, False
         return n_chunks, True  # broke out: a chunk's dispatch failed
+
+    def _settle_task_done(self, task) -> None:
+        self._settle_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            # _settle_and_deliver's finally keeps the inflight
+            # accounting correct even here, but an escape is a bug —
+            # surface it instead of leaving the exception unretrieved
+            logger.error("settle task died unexpectedly",
+                         exc_info=task.exception())
 
     def _start_regrow(self) -> None:
         """A pending event's device index outgrew the ring: grow and

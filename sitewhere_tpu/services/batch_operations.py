@@ -179,6 +179,12 @@ class BatchElementProcessor(BackgroundTaskComponent):
             engine.tenant_topic(TopicNaming.BATCH_ELEMENTS),
             group=f"{tenant_id}.batch-operations")
         processed = runtime.metrics.counter("batch.elements_processed")
+        # clean-handoff commit-through (same contract as the inbound
+        # processor): a cancellation mid-batch must not lose a handled
+        # chunk's commit — a redelivery would re-execute the chunk's
+        # commands against devices. The finally commits the handled
+        # prefix exactly.
+        handled: dict[tuple[str, int], int] = {}
         try:
             while True:
                 for record in await consumer.poll(max_records=16, timeout=0.5):
@@ -212,8 +218,16 @@ class BatchElementProcessor(BackgroundTaskComponent):
                                 chunk["operation_id"],
                                 BatchOperationStatus.FINISHED_WITH_ERRORS,
                                 ended=True)
+                    # slotted-attribute reads cannot raise — bookkeeping
+                    handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                 consumer.commit()
         finally:
+            try:
+                if handled:
+                    # commit the handled prefix (see above)
+                    consumer.commit(dict(handled))
+            except RuntimeError:
+                pass
             consumer.close()
 
     # -- command invocation elements ---------------------------------------

@@ -28,6 +28,7 @@ from sitewhere_tpu.config import TenantConfig
 from sitewhere_tpu.domain.events import DeviceCommandInvocation
 from sitewhere_tpu.domain.model import Device, DeviceCommand
 from sitewhere_tpu.kernel.bus import TopicNaming
+from sitewhere_tpu.kernel.fastlane import produce_settled
 from sitewhere_tpu.kernel.lifecycle import BackgroundTaskComponent
 from sitewhere_tpu.kernel.service import Service, TenantEngine
 
@@ -374,6 +375,12 @@ class CommandDeliveryManager(BackgroundTaskComponent):
         consumer = runtime.bus.subscribe(
             engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
             group=f"{tenant_id}.command-delivery")
+        # clean-handoff commit-through (same contract as the inbound
+        # processor): a cancellation mid-batch must not let a handled
+        # record's commit be lost — a redelivery would push the same
+        # commands to devices twice. The finally commits the handled
+        # prefix exactly.
+        handled: dict[tuple[str, int], int] = {}
         try:
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
@@ -384,24 +391,38 @@ class CommandDeliveryManager(BackgroundTaskComponent):
                     # command routing keeps draining
                     try:
                         value = record.value
-                        if not isinstance(value, list):
-                            continue
-                        for ev in value:
-                            if isinstance(ev, DeviceCommandInvocation):
+                        if isinstance(value, list):
+                            for ev in value:
+                                if not isinstance(
+                                        ev, DeviceCommandInvocation):
+                                    continue
                                 ok = await self._deliver(dm, ev)
                                 if ok:
                                     delivered.inc()
                                 else:
                                     failed.inc()
-                                    await runtime.bus.produce(
-                                        undelivered_topic, ev,
-                                        key=ev.device_id)
+                                    # the retry record must not vanish
+                                    # into a cancelled produce: settled
+                                    # on the broker's path or provably
+                                    # withdrawn (then the redelivery
+                                    # retries the invocation itself)
+                                    await produce_settled(
+                                        runtime.bus, undelivered_topic,
+                                        ev, key=ev.device_id)
                     except asyncio.CancelledError:
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
+                    # slotted-attribute reads cannot raise — bookkeeping
+                    handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                 consumer.commit()
         finally:
+            try:
+                if handled:
+                    # commit the handled prefix (see above)
+                    consumer.commit(dict(handled))
+            except RuntimeError:
+                pass
             consumer.close()
 
     async def _deliver(self, dm, invocation: DeviceCommandInvocation) -> bool:

@@ -70,6 +70,12 @@ class RegistrationManager(BackgroundTaskComponent):
         consumer = runtime.bus.subscribe(
             engine.tenant_topic(TopicNaming.UNREGISTERED_DEVICES),
             group=f"{tenant_id}.device-registration")
+        # clean-handoff commit-through (same contract as the inbound
+        # processor): a cancellation mid-batch must not lose a handled
+        # record's commit — a redelivery would re-run registration and
+        # re-send acks down device command routes. The finally commits
+        # the handled prefix exactly.
+        handled: dict[tuple[str, int], int] = {}
         try:
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
@@ -98,6 +104,8 @@ class RegistrationManager(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
+                    # slotted-attribute reads cannot raise — bookkeeping
+                    handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                 try:
                     consumer.commit(fence=engine.fence_token())
                 except FencedError:
@@ -105,6 +113,14 @@ class RegistrationManager(BackgroundTaskComponent):
                     # the new owner; the fleet worker stops these engines
                     engine.fence_lost()
         finally:
+            try:
+                if handled:
+                    # commit the handled prefix (see above); fenced or
+                    # evicted refusals leave the offsets to the owner
+                    consumer.commit(dict(handled),
+                                    fence=engine.fence_token())
+            except (FencedError, RuntimeError):
+                pass
             consumer.close()
 
     def _register(self, dm, batch: RegistrationBatch) -> RegistrationAck:

@@ -198,8 +198,12 @@ class InboundProcessor(BackgroundTaskComponent):
                 batch.ctx.trace_id, "inbound.enrich", tenant_id,
                 t_span, time.monotonic() - t_span, len(batch))
         elif isinstance(batch, RegistrationBatch):
-            await runtime.bus.produce(unregistered_topic, batch,
-                                      fence=engine.fence_token())
+            # same cancellation accounting as the enriched publish: a
+            # cancel landing inside this produce must not leave "did the
+            # registration request go out?" ambiguous for the commit —
+            # settled-and-marked, or provably withdrawn and redelivered
+            await produce_settled(runtime.bus, unregistered_topic, batch,
+                                  fence=engine.fence_token(), mark=mark)
         else:
             logger.warning("inbound: unknown record %r", type(batch))
 

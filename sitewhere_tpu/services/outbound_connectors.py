@@ -442,6 +442,12 @@ class OutboundManager(BackgroundTaskComponent):
             [engine.tenant_topic(TopicNaming.OUTBOUND_ENRICHED),
              engine.tenant_topic(TopicNaming.SCORED_EVENTS)],
             group=f"{tenant_id}.outbound-connectors")
+        # clean-handoff commit-through (same contract as the inbound
+        # processor): a cancellation mid-batch must not lose a handled
+        # record's commit — a redelivery would re-fire every connector
+        # (webhooks, external sinks) on the same record. The finally
+        # commits the handled prefix exactly.
+        handled: dict[tuple[str, int], int] = {}
         try:
             while True:
                 for record in await consumer.poll(max_records=64, timeout=0.5):
@@ -464,8 +470,16 @@ class OutboundManager(BackgroundTaskComponent):
                         raise
                     except Exception as exc:  # noqa: BLE001 - quarantined
                         await engine.dead_letter(record, exc, self.path)
+                    # slotted-attribute reads cannot raise — bookkeeping
+                    handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
                 consumer.commit()
         finally:
+            try:
+                if handled:
+                    # commit the handled prefix (see above)
+                    consumer.commit(dict(handled))
+            except RuntimeError:
+                pass
             consumer.close()
 
 

@@ -8,7 +8,7 @@ import logging
 import textwrap
 
 from sitewhere_tpu.analysis import FAULT_SITES, METRICS, lint_package, lint_sources
-from sitewhere_tpu.analysis.engine import Baseline
+from sitewhere_tpu.analysis.engine import Baseline, Finding, Module, Project
 from sitewhere_tpu.analysis.registry import (
     COUNTERS,
     GAUGES,
@@ -156,9 +156,12 @@ _NAKED_LOOP = """
     class Worker:
         async def _run(self):
             consumer = self.bus.subscribe("t")
-            while True:
-                for record in await consumer.poll(timeout=0.5):
-                    self.handle(record)
+            try:
+                while True:
+                    for record in await consumer.poll(timeout=0.5):
+                        self.handle(record)
+                    consumer.commit()
+            finally:
                 consumer.commit()
 """
 
@@ -188,14 +191,17 @@ def test_dlq01_negative_quarantined_loop():
         class Worker:
             async def _run(self):
                 consumer = self.bus.subscribe("t")
-                while True:
-                    for record in await consumer.poll(timeout=0.5):
-                        try:
-                            self.handle(record)
-                        except asyncio.CancelledError:
-                            raise
-                        except Exception as exc:
-                            await self.engine.dead_letter(record, exc, self.path)
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):
+                            try:
+                                self.handle(record)
+                            except asyncio.CancelledError:
+                                raise
+                            except Exception as exc:
+                                await self.engine.dead_letter(record, exc, self.path)
+                        consumer.commit()
+                finally:
                     consumer.commit()
     """)
     assert _codes(rep) == []
@@ -479,6 +485,516 @@ def test_lif01_negative_chained_and_hooks():
     assert _codes(rep) == []
 
 
+# -- async-dataflow layer (engine.FuncFlow / Project.resolve_call) -----------
+
+
+def _flow(src, qualname, path=SVC, extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    modules = [Module(rel, s) for rel, s in sorted(sources.items())]
+    project = Project(modules)
+    mod = next(m for m in modules if m.relpath == path)
+    return project, mod, project.flow(mod).functions[qualname]
+
+
+def test_dataflow_await_boundary_is_end_of_expression():
+    # the subtlety every await-segmentation scheme must get right: a
+    # load INSIDE an awaited call's arguments evaluates before the
+    # coroutine yields, so it is pre-suspension for that await — only
+    # the load after the await crosses a suspension point
+    _, _, fl = _flow("""
+        class W:
+            async def heartbeat(self):
+                pending = self.count_pending()
+                await self.bus.produce("beats", {"pending": pending})
+                later = pending
+    """, "W.heartbeat")
+    assert len(fl.await_points) == 1
+    in_args, after = fl.loads["pending"]
+    assert fl.segment_of(in_args) == 0
+    assert fl.segment_of(after) == 1
+
+
+def test_dataflow_async_for_and_with_are_suspension_points():
+    _, _, fl = _flow("""
+        class W:
+            async def drain(self):
+                async with self.lock:
+                    async for rec in self.stream():
+                        self.handle(rec)
+    """, "W.drain")
+    assert len(fl.await_points) == 2
+
+
+def test_dataflow_self_attribute_roots():
+    _, _, fl = _flow("""
+        class W:
+            async def apply(self):
+                t = self.assignment.get("t1")
+                self.owned = set()
+                del self.prev
+    """, "W.apply")
+    assert [r for _, r in fl.self_reads] == ["assignment"]
+    assert sorted(r for _, r in fl.self_writes) == ["owned", "prev"]
+
+
+def test_dataflow_capture_first_wins_and_records_roots():
+    _, _, fl = _flow("""
+        class W:
+            async def f(self):
+                mine = self.assignment.get("t")
+                mine = {}
+    """, "W.f")
+    _, roots, calls = fl.captures["mine"]
+    assert roots == frozenset({"assignment"})
+    assert len(calls) == 1
+
+
+def test_dataflow_resolve_call_levels():
+    helper = """
+        def route():
+            pass
+    """
+    project, mod, fl = _flow("""
+        from sitewhere_tpu.services.helper import route as rt
+
+        def top():
+            pass
+
+        class W:
+            def assigned_to_me(self):
+                return [t for t in self.assignment]
+
+            async def f(self):
+                a = self.assigned_to_me()
+                b = rt()
+                c = top()
+                d = self.conn.execute()
+    """, "W.f", extra={"sitewhere_tpu/services/helper.py": helper})
+    call_of = {n: fl.captures[n][2][0] for n in "abcd"}
+    self_m = project.resolve_call(mod, call_of["a"], "W")
+    assert self_m is not None and self_m.qualname == "W.assigned_to_me"
+    imp = project.resolve_call(mod, call_of["b"], "W")
+    assert imp is not None and imp.qualname == "route"
+    tl = project.resolve_call(mod, call_of["c"], "W")
+    assert tl is not None and tl.qualname == "top"
+    # chained-attribute receiver: opaque by design, resolves to None
+    assert project.resolve_call(mod, call_of["d"], "W") is None
+
+
+def test_dataflow_method_resolution_follows_bases():
+    project, mod, fl = _flow("""
+        class Base:
+            def snap(self):
+                return self.assignment
+
+        class W(Base):
+            async def f(self):
+                a = self.snap()
+    """, "W.f")
+    callee = project.resolve_call(mod, fl.captures["a"][2][0], "W")
+    assert callee is not None and callee.qualname == "Base.snap"
+
+
+# -- TSK01 -------------------------------------------------------------------
+
+
+def test_tsk01_bare_create_task_expression():
+    rep = _lint("""
+        import asyncio
+
+        class C:
+            async def go(self):
+                asyncio.create_task(self.work())
+    """)
+    assert _codes(rep) == ["TSK01"]
+    assert "weak reference" in rep.findings[0].message
+    assert rep.findings[0].qualname == "C.go"
+
+
+def test_tsk01_dead_local_assignment():
+    rep = _lint("""
+        import asyncio
+
+        class C:
+            async def go(self):
+                t = asyncio.create_task(self.work())
+                return None
+    """)
+    assert _codes(rep) == ["TSK01"]
+    assert "`t`" in rep.findings[0].message
+
+
+def test_tsk01_import_alias_and_loop_receiver():
+    rep = _lint("""
+        import asyncio
+        from asyncio import ensure_future
+
+        class C:
+            async def go(self):
+                ensure_future(self.work())
+                asyncio.get_running_loop().create_task(self.work())
+    """)
+    assert _codes(rep) == ["TSK01", "TSK01"]
+
+
+def test_tsk01_negative_retained_shapes():
+    rep = _lint("""
+        import asyncio
+
+        class C:
+            async def go(self):
+                t = asyncio.create_task(self.work())
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+                self.task = asyncio.create_task(self.work())
+                self._by_id[3] = asyncio.create_task(self.work())
+                await asyncio.gather(asyncio.create_task(self.work()))
+                return asyncio.create_task(self.work())
+
+            async def structured(self, tg):
+                tg.create_task(self.work())
+    """)
+    assert _codes(rep) == []
+
+
+def test_tsk01_suppressed_and_baselined():
+    src = """
+        import asyncio
+
+        class C:
+            async def go(self):
+                asyncio.create_task(self.work())  # swxlint: disable=TSK01 - fixture
+    """
+    rep = _lint(src)
+    assert _codes(rep) == [] and len(rep.suppressed) == 1
+    bare = src.replace("  # swxlint: disable=TSK01 - fixture", "")
+    bl = Baseline(entries={(SVC, "TSK01", "C.go"): "documented fixture"})
+    rep = _lint(bare, baseline=bl)
+    assert _codes(rep) == [] and len(rep.baselined) == 1
+
+
+# -- CAN01 -------------------------------------------------------------------
+
+
+def test_can01_commit_loop_without_finally_frontier():
+    rep = _lint("""
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                while True:
+                    for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                        self.handle(record)
+                    consumer.commit()
+    """)
+    assert _codes(rep) == ["CAN01"]
+    assert "finally" in rep.findings[0].message
+    assert rep.findings[0].qualname == "Loop._run"
+
+
+def test_can01_negative_finally_commits_handled_frontier():
+    rep = _lint("""
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = {}
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                            self.handle(record)
+                            handled[(record.topic, record.partition)] = record.offset + 1
+                        consumer.commit()
+                finally:
+                    if handled:
+                        consumer.commit(dict(handled))
+                    consumer.close()
+    """)
+    assert _codes(rep) == []
+
+
+def test_can01_negative_frontier_handoff_to_stop_path():
+    # FastLane shape: batch-granular frontier from delivered_positions,
+    # handed to the stop path in the finally instead of committed there
+    rep = _lint("""
+        class Lane:
+            def checkpoint_commit(self, consumer):
+                consumer.commit()
+
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = consumer.delivered_positions()
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                            self.handle(record)
+                        handled = consumer.delivered_positions()
+                        self.checkpoint_commit(consumer)
+                finally:
+                    self.engine.stopped(consumer, handled)
+    """)
+    assert _codes(rep) == []
+
+
+def test_can01_no_commit_effect_no_finding():
+    # a poll loop that never commits (telemetry observer style) has no
+    # cancellation-commit window to protect
+    rep = _lint("""
+        class Loop:
+            async def _run(self):
+                while True:
+                    for record in await self.consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                        self.handle(record)
+    """)
+    assert _codes(rep) == []
+
+
+def test_can01_raw_produce_in_committing_loop():
+    rep = _lint("""
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = {}
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                            await self.bus.produce("out", record.value)
+                            handled[(record.topic, record.partition)] = record.offset + 1
+                        consumer.commit()
+                finally:
+                    consumer.commit(dict(handled))
+    """)
+    assert _codes(rep) == ["CAN01"]
+    assert "produce_settled" in rep.findings[0].hint
+    assert ".produce(" in rep.findings[0].message
+
+
+def test_can01_follows_one_level_into_loop_callee():
+    # the `self._handle(record)` shape: the produce lives one call down,
+    # the finding lands on the produce LINE so a same-line disable can
+    # carry the at-least-once justification
+    rep = _lint("""
+        class Loop:
+            async def _handle(self, record):
+                await self.bus.produce("out", record.value)
+
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = {}
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                            await self._handle(record)
+                            handled[(record.topic, record.partition)] = record.offset + 1
+                        consumer.commit()
+                finally:
+                    consumer.commit(dict(handled))
+    """)
+    assert _codes(rep) == ["CAN01"]
+    assert rep.findings[0].qualname == "Loop._handle"
+
+
+def test_can01_negative_settled_shield_and_probe():
+    rep = _lint("""
+        import asyncio
+        from sitewhere_tpu.kernel.fastlane import produce_settled
+
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = {}
+                probe = asyncio.Event()
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                            await produce_settled(self.bus, "out", record.value)
+                            await asyncio.shield(self.bus.produce("aux", record.value))
+                            self.bus.produce_nowait("probe", record.value, _sent=probe)
+                            handled[(record.topic, record.partition)] = record.offset + 1
+                        consumer.commit()
+                finally:
+                    consumer.commit(dict(handled))
+    """)
+    assert _codes(rep) == []
+
+
+def test_can01_quarantine_produce_is_exempt():
+    # the DLQ publish inside the except handler is not part of the happy
+    # per-record path: a replay after a cancel re-quarantines the same
+    # poison record idempotently
+    rep = _lint("""
+        import asyncio
+
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = {}
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):
+                            try:
+                                self.handle(record)
+                            except asyncio.CancelledError:
+                                raise
+                            except Exception as exc:
+                                await self.bus.produce("errors", record.value)
+                                await self.engine.dead_letter(record, exc, self.path)
+                            handled[(record.topic, record.partition)] = record.offset + 1  # swxlint: disable=DLQ01
+                        consumer.commit()
+                finally:
+                    consumer.commit(dict(handled))
+    """)
+    assert _codes(rep) == []
+
+
+def test_can01_pre_fix_command_delivery_shape_is_true_positive():
+    # the known-fixed PR 14 incident shape, pinned: per-record deliver
+    # with an undelivered-topic produce plus a covering batch commit and
+    # NO finally — both cancellation windows open at once
+    rep = _lint("""
+        class Courier:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                while True:
+                    for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                        ok = await self.deliver(record.value)
+                        if not ok:
+                            await self.bus.produce("undelivered", record.value)
+                    consumer.commit()
+    """)
+    assert sorted(_codes(rep)) == ["CAN01", "CAN01"]
+    messages = " ".join(f.message for f in rep.findings)
+    assert "frontier" in messages and "unknowable" in messages
+
+
+def test_can01_suppressed_and_baselined():
+    src = """
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                handled = {}
+                try:
+                    while True:
+                        for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                            await self.bus.produce("out", record.value)  # swxlint: disable=CAN01 - at-least-once by design
+                            handled[(record.topic, record.partition)] = record.offset + 1
+                        consumer.commit()
+                finally:
+                    consumer.commit(dict(handled))
+    """
+    rep = _lint(src)
+    assert _codes(rep) == []
+    assert sum(1 for f in rep.suppressed if f.code == "CAN01") == 1
+    # (a) finding baselined by qualname, the control-plane-loop workflow
+    bare = """
+        class Loop:
+            async def _run(self):
+                consumer = self.bus.subscribe("t")
+                while True:
+                    for record in await consumer.poll(timeout=0.5):  # swxlint: disable=DLQ01
+                        self.handle(record)
+                    consumer.commit()
+    """
+    bl = Baseline(entries={
+        (SVC, "CAN01", "Loop._run"): "idempotent control records"})
+    rep = _lint(bare, baseline=bl)
+    assert _codes(rep) == [] and len(rep.baselined) == 1
+
+
+# -- ASY02 -------------------------------------------------------------------
+
+
+def test_asy02_stale_snapshot_across_await():
+    # the PR 8 stale-`mine` dual-ownership race, pinned as the pre-fix
+    # shape: ownership snapshotted, awaited, then acted on un-re-read
+    rep = _lint("""
+        class Worker:
+            async def apply(self, placement):
+                mine = {t for t in self.assignment if self.assignment[t] == self.me}
+                await self.release_stale(placement)
+                for tid in mine:
+                    self.start_engine(tid)
+    """)
+    assert _codes(rep) == ["ASY02"]
+    assert "self.assignment" in rep.findings[0].message
+    assert "stale-snapshot" in rep.findings[0].message
+    assert rep.findings[0].qualname == "Worker.apply"
+
+
+def test_asy02_one_level_call_resolution():
+    # the guarded root hides behind `self.assigned_to_me()` — the
+    # checker follows one call level to find it
+    rep = _lint("""
+        class Worker:
+            def assigned_to_me(self):
+                return [t for t, w in self.assignment.items() if w == self.me]
+
+            async def apply(self):
+                mine = self.assigned_to_me()
+                await self.publish()
+                for tid in mine:
+                    self.start_engine(tid)
+    """)
+    assert _codes(rep) == ["ASY02"]
+
+
+def test_asy02_negative_root_reread_after_await():
+    # the known-fixed shape (FleetWorker.apply): the snapshot exists but
+    # every post-await act re-reads the root first
+    rep = _lint("""
+        class Worker:
+            async def apply(self, placement):
+                mine = set(self.assignment)
+                await self.publish()
+                for tid in mine:
+                    if self.assignment.get(tid) != self.me:
+                        continue
+                    self.start_engine(tid)
+    """)
+    assert _codes(rep) == []
+
+
+def test_asy02_negative_no_cross_await_use():
+    rep = _lint("""
+        class Worker:
+            async def apply(self):
+                mine = set(self.assignment)
+                self.act(mine)
+                await self.publish()
+    """)
+    assert _codes(rep) == []
+
+
+def test_asy02_negative_unguarded_roots():
+    # only the named ownership/placement/epoch roots are decision state
+    rep = _lint("""
+        class Worker:
+            async def report(self):
+                n = len(self.buffer)
+                await self.publish()
+                self.log(n)
+    """)
+    assert _codes(rep) == []
+
+
+def test_asy02_suppressed_and_baselined():
+    src = """
+        class Worker:
+            async def apply(self):
+                mine = set(self.assignment)
+                await self.publish()
+                self.act(mine)  # swxlint: disable=ASY02 - epoch-fenced downstream
+    """
+    rep = _lint(src)
+    assert _codes(rep) == [] and len(rep.suppressed) == 1
+    bare = src.replace("  # swxlint: disable=ASY02 - epoch-fenced downstream",
+                       "")
+    bl = Baseline(entries={
+        (SVC, "ASY02", "Worker.apply"): "documented: fenced downstream"})
+    rep = _lint(bare, baseline=bl)
+    assert _codes(rep) == [] and len(rep.baselined) == 1
+
+
 # -- baseline workflow -------------------------------------------------------
 
 
@@ -523,6 +1039,39 @@ def test_stale_baseline_entries_are_reported():
     assert rep.findings == []
     assert len(rep.stale_baseline) == 1
     assert rep.stale_baseline[0]["qualname"] == "Gone._run"
+
+
+def test_stale_baseline_fails_the_build():
+    # a stale entry is either a fixed finding (prune it) or fingerprint
+    # drift silently un-grandfathering a live one — both fail the gate
+    bl = Baseline(entries={
+        (SVC, "DLQ01", "Gone._run"): "was fixed; entry should be pruned"})
+    rep = _lint("async def clean():\n    pass\n", baseline=bl)
+    assert rep.exit_code == 1
+    assert "error:" in rep.render_text()
+
+
+def test_baseline_since_roundtrip(tmp_path):
+    raw = {"entries": [{"path": SVC, "code": "ASY01", "qualname": "poll",
+                        "reason": "documented", "since": "2026-08-03"}]}
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps(raw))
+    bl = Baseline.load(p)
+    assert bl.since[(SVC, "ASY01", "poll")] == "2026-08-03"
+    # the stale report carries the date so a pruner sees the entry's age
+    rep = _lint("async def clean():\n    pass\n", baseline=bl)
+    assert rep.stale_baseline[0]["since"] == "2026-08-03"
+
+
+def test_baseline_dump_stamps_since(tmp_path):
+    import datetime
+
+    f = Finding(path=SVC, line=3, code="ASY01", message="m", hint="h",
+                qualname="poll")
+    p = tmp_path / "bl.json"
+    Baseline.dump([f], p)
+    doc = json.loads(p.read_text())
+    assert doc["entries"][0]["since"] == datetime.date.today().isoformat()
 
 
 def test_line_numbers_not_part_of_baseline_fingerprint():
@@ -584,6 +1133,16 @@ def test_cli_json_report(capsys):
     assert rc == 0 and out["clean"] is True
     assert out["checked_files"] > 50
     assert "findings" in out and out["findings"] == []
+    # per-code wall time rides the CI artifact; every registered code
+    # (including the concurrency suite) reports its column
+    assert set(out["timings_s"]) >= {"ASY01", "ASY02", "CAN01", "TSK01",
+                                     "DLQ01", "TRC01", "FEN01"}
+    assert all(t >= 0 for t in out["timings_s"].values())
+
+
+def test_report_timings_populated_on_fixture_runs():
+    rep = _lint("async def f():\n    pass\n")
+    assert {"TSK01", "CAN01", "ASY02"} <= set(rep.timings)
 
 
 def test_swx_lint_subcommand(capsys):

@@ -180,3 +180,55 @@ def test_contract_long_poll_wakes_on_produce(run, bus_impl):
             c.close()
 
     run(main())
+
+
+# -- background-op retention (swx lint TSK01 regression) ---------------------
+
+
+def test_spawn_logged_retains_and_surfaces_failures(run, caplog):
+    """`_spawn_logged` is the adapter's retained fire-and-forget: the
+    task set holds the strong reference the event loop does not (an
+    unretained task can be GC'd mid-flight), and a failed background op
+    lands in the log instead of dying with an unretrieved exception —
+    pre-fix, `produce_nowait`/`commit`/`close` dropped the handle."""
+    import logging
+
+    from sitewhere_tpu.kernel.kafka import _spawn_logged
+
+    async def main():
+        tasks: set = set()
+        gate = asyncio.Event()
+
+        async def held():
+            await gate.wait()
+
+        t = _spawn_logged(tasks, held())
+        assert t in tasks          # strong ref while in flight
+        gate.set()
+        await t
+        await asyncio.sleep(0)
+        assert t not in tasks      # done callback prunes the set
+
+        async def boom():
+            raise RuntimeError("background op exploded")
+
+        t2 = _spawn_logged(tasks, boom())
+        await t2                   # _log_failure retrieves + logs
+        await asyncio.sleep(0)
+        assert t2 not in tasks
+
+    with caplog.at_level(logging.ERROR, logger="sitewhere_tpu.kernel.kafka"):
+        run(main())
+    assert any("background operation failed" in r.getMessage()
+               for r in caplog.records)
+
+
+def test_kafka_produce_nowait_task_is_retained(run):
+    async def main():
+        async with kafka_bus() as bus:
+            bus.produce_nowait("c-bg", {"i": 1}, key="k")
+            assert bus._bg  # in-flight background produce strongly held
+            while bus._bg:  # drains once the produce settles
+                await asyncio.sleep(0.01)
+
+    run(main())

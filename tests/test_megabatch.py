@@ -402,3 +402,56 @@ def test_megabatch_chaos_quarantines_with_provenance(run):
             consumer.close()
 
     run(main())
+
+
+# -- settle-task retention (swx lint TSK01 regression) -----------------------
+
+
+def test_settle_task_retained_until_delivery(run):
+    """The in-flight settle task is strongly referenced: the event loop
+    keeps only a weak ref, so the pre-fix dropped handle could be GC'd
+    mid-flight — wedging `inflight`/`_outstanding` forever with the
+    megabatch never settling."""
+    async def main():
+        model = build_model("zscore", window=16)
+        pool = SharedScoringPool(
+            model, MetricsRegistry(),
+            PoolConfig(batch_buckets=(32,), batch_window_ms=50.0))
+        delivered: list = []
+
+        async def deliver(scored):
+            delivered.append(scored)
+
+        slot = pool.register("a", TelemetryStore(history=32), 6.0, deliver)
+        await wait_until(lambda: pool.ready, timeout=60.0)
+        slot.admit(_batch("a"))
+        pool._flush_round()
+        assert len(pool._settle_tasks) == 1  # strong ref while in flight
+        await wait_until(lambda: len(delivered) == 1, timeout=30.0)
+        await wait_until(lambda: not pool._settle_tasks, timeout=5.0)
+        pool.close()
+
+    run(main())
+
+
+def test_settle_task_failure_is_logged(run, caplog):
+    """An escaped settle exception is retrieved and surfaced by the
+    supervisor callback instead of dying unretrieved."""
+    import logging
+
+    async def main():
+        pool = SharedScoringPool.__new__(SharedScoringPool)
+        pool._settle_tasks = set()
+
+        async def boom():
+            raise RuntimeError("settle exploded")
+
+        task = asyncio.get_running_loop().create_task(boom())
+        pool._settle_tasks.add(task)
+        task.add_done_callback(pool._settle_task_done)
+        while pool._settle_tasks:
+            await asyncio.sleep(0)
+
+    with caplog.at_level(logging.ERROR, logger="sitewhere_tpu.scoring.pool"):
+        run(main())
+    assert any("settle task died" in r.getMessage() for r in caplog.records)

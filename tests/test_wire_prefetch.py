@@ -560,3 +560,37 @@ def test_prefetch_delivers_zero_copy_views(run):
         await server.stop()
 
     run(main())
+
+
+# -- push-loop supervision (swx lint TSK01 regression) -----------------------
+
+
+def test_push_loop_death_is_supervised(run, caplog):
+    """An unexpected escape from a prefetch push loop is logged — the
+    pre-fix task died silently, wedging the consumer's credit window
+    with no traceback anywhere."""
+    import logging
+
+    async def main():
+        bus = EventBus(default_partitions=1)
+        server = BusServer(bus)
+
+        async def doomed(cid, consumer, writer, st):
+            raise RuntimeError("push loop exploded")
+
+        server._push_loop = doomed
+        await server.start()
+        remote = RemoteEventBus("127.0.0.1", server.port,
+                                prefetch=True, prefetch_credit=8)
+        await remote.initialize()
+        consumer = remote.subscribe("t", group="g")
+        await consumer.poll(max_records=1, timeout=0.3)  # forces subscribe
+        await asyncio.sleep(0.05)
+        consumer.close()
+        await remote.stop()
+        await server.stop()
+
+    with caplog.at_level(logging.ERROR, logger="sitewhere_tpu.kernel.wire"):
+        run(main())
+    assert any("died unexpectedly" in r.getMessage()
+               for r in caplog.records)
