@@ -195,6 +195,8 @@ def _lint_summary():
 def _error_artifact(args, msg: str) -> str:
     return json.dumps({
         "metric": ("train_windows_per_sec" if args.train
+                   else "replay_events_per_sec"
+                   if getattr(args, "replay", False)
                    else "pipeline_scored_events_per_sec"),
         "value": 0.0,
         "unit": "windows/s" if args.train else "events/s",
@@ -2084,6 +2086,190 @@ async def run_overload_bench(args) -> dict:
     }
 
 
+def _drop_page_cache() -> bool:
+    """Best-effort OS page-cache drop for the cold-IO replay leg (needs
+    root; the artifact records whether it actually happened — a `cold`
+    artifact with cache_dropped=false is really a warm measurement and
+    says so)."""
+    try:
+        os.sync()
+        with open("/proc/sys/vm/drop_caches", "w") as f:
+            f.write("3\n")
+        return True
+    except OSError:
+        return False
+
+
+async def run_replay_bench(args) -> dict:
+    """Cold-tier replay bench (sitewhere_tpu/history): ingest a synthetic
+    corpus into per-tenant durable segment logs, compact it into the
+    columnar history tier, then stream it back through the megabatch
+    scoring pool at full speed and report replay events/s.
+
+    --replay-io warm  reads straight out of the OS page cache (the
+                      corpus was just written)
+    --replay-io cold  drops the page cache before EVERY timed pass so
+                      block reads pay real disk I/O
+
+    JIT warmup is excluded from both legs: an untimed full replay pass
+    runs first, and the in-process XLA executable cache survives the
+    page-cache drop — cold measures the disk, not the compiler.
+    --live-median stamps the same-day live saturation median (the
+    ab_compare replay preset threads it from the live leg's artifact) so
+    each replay artifact carries its own vs-live ratio.
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+    from sitewhere_tpu.history import EventHistoryStore, ReplayEngine
+    from sitewhere_tpu.kernel.metrics import MetricsRegistry
+    from sitewhere_tpu.models.registry import build_model
+    from sitewhere_tpu.persistence.durable import RT_MEASUREMENTS, SegmentLog
+    from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool
+
+    platform, device_kind, n_chips = probe_backend()
+
+    made_tmp = not args.durable
+    if args.durable:
+        import shutil
+
+        if os.path.isdir(args.durable) and os.listdir(args.durable) \
+                and not args.force_wipe:
+            raise RuntimeError(
+                f"--durable {args.durable!r} exists and is not empty; "
+                "pass --force-wipe or point it somewhere fresh")
+        shutil.rmtree(args.durable, ignore_errors=True)
+        os.makedirs(args.durable, exist_ok=True)
+        root = args.durable
+    else:
+        root = tempfile.mkdtemp(prefix="swx-replay-bench-")
+
+    tenants = [f"bench{i}" for i in range(max(args.tenants, 1))]
+    per_tenant = max(args.replay_events // len(tenants), 1)
+    # device_index is a PER-TENANT space: every tenant keeps the full
+    # fleet width, so megabatch rows pack dense (splitting the space
+    # T ways would quarter per-round fill at T=4)
+    devices = args.devices
+    window_s = 60.0
+    rng = np.random.default_rng(7)
+    t0 = 1_700_000_000.0
+
+    corpus_t = time.monotonic()
+    stores: dict = {}
+    compact_segments = compact_events = 0
+    compact_s = 0.0
+    for tid in tenants:
+        log = SegmentLog(os.path.join(root, tid, "events"),
+                         segment_bytes=8 << 20)
+        remaining, t = per_tenant, t0
+        while remaining > 0:
+            n = min(65536, remaining)
+            dev = rng.integers(0, devices, n).astype(np.uint32)
+            ts = (t + np.sort(rng.random(n)) * window_s).astype(np.float64)
+            val = rng.normal(20.0, 5.0, n).astype(np.float32)
+            log.append(RT_MEASUREMENTS, MeasurementBatch(
+                BatchContext(tid), dev, np.zeros(n, np.uint16), val,
+                ts).encode())
+            remaining -= n
+            t += window_s
+        log.close()
+        store = EventHistoryStore(os.path.join(root, tid, "history"),
+                                  source=log, window_s=window_s)
+        rep = store.compact(through_seq=log._seq)
+        compact_segments += rep["segments"]
+        compact_events += rep["events"]
+        compact_s += rep["elapsed_s"]
+        stores[tid] = store
+    corpus_s = time.monotonic() - corpus_t
+
+    metrics = MetricsRegistry()
+    model = build_model(args.model, window=args.window)
+    # replay is throughput-plane, not latency-plane: the extra 8192
+    # bucket lets a full-width rank round (devices=8192) dispatch as ONE
+    # dense megabatch (a PERFORMANCE.md replay config lever). Smaller
+    # buckets still serve the Poisson tail rounds.
+    pool = SharedScoringPool(model, metrics, PoolConfig(
+        batch_buckets=(256, 1024, 4096, 8192),
+        batch_window_ms=args.window_ms,
+        max_inflight=args.max_inflight))
+    engine = ReplayEngine(pool, metrics=metrics)
+
+    async def replay_all() -> int:
+        reports = await asyncio.gather(*[
+            engine.replay(tid, stores[tid], 6.0) for tid in tenants])
+        return sum(r["events"] for r in reports)
+
+    warm_t = time.monotonic()
+    await replay_all()  # untimed: every bucket shape compiles here
+    warmup_s = time.monotonic() - warm_t
+
+    trials = []
+    cache_dropped = None
+    for _ in range(max(args.sat_trials, 1)):
+        if args.replay_io == "cold":
+            cache_dropped = _drop_page_cache()
+        t1 = time.monotonic()
+        events = await replay_all()
+        elapsed = time.monotonic() - t1
+        trials.append({"events": events, "elapsed_s": round(elapsed, 4),
+                       "events_per_sec": round(events / elapsed, 1)})
+    pool.close()
+    blocks = sum(s.stats()["blocks"] for s in stores.values())
+    windows = sum(s.stats()["windows"] for s in stores.values())
+    corpus_bytes = sum(s.stats()["bytes"] for s in stores.values())
+    for s in stores.values():
+        s.close()
+    if made_tmp:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    rates = sorted(t["events_per_sec"] for t in trials)
+    value, median = rates[-1], rates[len(rates) // 2]
+    result = {
+        "metric": "replay_events_per_sec",
+        "value": value,
+        "value_median": median,
+        "unit": "events/s",
+        "vs_baseline": round(value / 1e6, 4),
+        "io": args.replay_io,
+        "cache_dropped": cache_dropped,
+        "model": args.model,
+        "tenants": len(tenants),
+        "events": per_tenant * len(tenants),
+        "windows": windows,
+        "blocks": blocks,
+        "corpus_bytes": corpus_bytes,
+        "corpus_build_s": round(corpus_s, 2),
+        "compact": {"segments": compact_segments,
+                    "events": compact_events,
+                    "elapsed_s": round(compact_s, 3),
+                    "events_per_sec": round(
+                        compact_events / compact_s, 1) if compact_s else 0.0},
+        "warmup_s": round(warmup_s, 3),
+        "trials": trials,
+        "platform": platform, "device_kind": device_kind, "chips": n_chips,
+        "lint": _lint_summary(),
+    }
+    if args.live_median > 0:
+        result["live_saturation_median"] = args.live_median
+        result["vs_live_median"] = round(median / args.live_median, 3)
+    return result
+
+
 async def run_bench(args) -> dict:
     import jax
 
@@ -2774,6 +2960,28 @@ def main() -> None:
     parser.add_argument("--hog-multiple", type=float, default=10.0,
                         help="hog offered load as a multiple of its "
                              "quota")
+    parser.add_argument("--replay", action="store_true",
+                        help="historical-replay bench: ingest a "
+                             "synthetic corpus into durable segment "
+                             "logs, compact it into the columnar cold "
+                             "tier, and stream it back through the "
+                             "megabatch scoring pool (sitewhere_tpu/"
+                             "history); artifact reports replay "
+                             "events/s")
+    parser.add_argument("--replay-io", default="warm",
+                        choices=["cold", "warm"],
+                        help="cold drops the OS page cache before every "
+                             "timed replay pass (real disk reads; "
+                             "best-effort, recorded in the artifact); "
+                             "warm reads from the page cache")
+    parser.add_argument("--replay-events", type=int, default=500_000,
+                        help="total corpus size (events) for --replay, "
+                             "split across --tenants")
+    parser.add_argument("--live-median", type=float, default=0.0,
+                        help="same-day live saturation median (events/s) "
+                             "to stamp into the --replay artifact beside "
+                             "the replay rate (ab_compare replay preset "
+                             "threads it from the live leg)")
     parser.add_argument("--probe-horizon", type=float, default=600.0,
                         help="supervisor: total seconds to keep re-probing "
                              "a dead/hung backend before giving up")
@@ -2905,6 +3113,7 @@ def main() -> None:
     try:
         result = (run_train_bench(args) if args.train
                   else run_gnn_bench(args) if args.gnn
+                  else asyncio.run(run_replay_bench(args)) if args.replay
                   else asyncio.run(run_split_bench(args)) if args.split
                   else asyncio.run(run_ramp_bench(args)) if args.ramp
                   else asyncio.run(run_fleet_bench(args))

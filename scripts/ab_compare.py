@@ -193,6 +193,30 @@ def wire_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
     return "\n".join(out)
 
 
+def replay_delta_table(live: dict, cold: dict, warm: dict) -> str:
+    """Replay-preset table: the cold-tier replay plane's events/s
+    against the same-day live saturation median. Every leg's artifact
+    records its own model and fleet shape — the live leg is the
+    repo-standard saturation bench, the replay legs run the replay
+    plane's natural dispatch-bound configuration (the same-model
+    comparison is in docs/PERFORMANCE.md)."""
+    lm = float(live.get("value_median") or 0.0)
+    rows = [("| leg | events/s (median) | best | vs live median |"),
+            ("|---|---|---|---|"),
+            (f"| live saturation ({live.get('model')}) | {lm:,.0f} | "
+             f"{float(live.get('value') or 0):,.0f} | 1.00x |")]
+    for tag, art in (("replay cold", cold), ("replay warm", warm)):
+        m = float(art.get("value_median") or 0.0)
+        note = ""
+        if art.get("io") == "cold" and art.get("cache_dropped") is False:
+            note = " — CACHE DROP FAILED (really warm)"
+        rows.append(
+            f"| {tag} ({art.get('model')}){note} | {m:,.0f} | "
+            f"{float(art.get('value') or 0):,.0f} | "
+            f"{(m / lm if lm else 0.0):.2f}x |")
+    return "\n".join(rows)
+
+
 def ramp_delta_table(name_a: str, a: dict, name_b: str, b: dict) -> str:
     """Predictive-preset table: backlog event-seconds + good-tenant
     collateral latency (lower is better on both), scale timing, and
@@ -335,7 +359,8 @@ def main() -> int:
     parser.add_argument("preset", choices=["egress", "fastlane", "lanes",
                                            "megabatch", "observe",
                                            "fleet", "mesh", "fleetobs",
-                                           "wire", "predictive"])
+                                           "wire", "predictive",
+                                           "replay"])
     parser.add_argument("--mesh-shape", default="1x8",
                         help="DxM mesh for the mesh preset's on leg "
                              "(forced host-platform devices on CPU "
@@ -433,6 +458,22 @@ def main() -> int:
         pairs = [("off", ["--ramp", "--no-forecast"]),
                  ("on", ["--ramp"])]
         names = ("forecast off (reactive)", "forecast on (predictive)")
+    elif args.preset == "replay":
+        # THREE legs, one rig, one day: the standard live saturation
+        # bench (the denominator every committed BENCH artifact
+        # reports), then the historical replay plane reading the
+        # columnar cold tier back from disk (page cache dropped before
+        # every timed pass) and from the page cache. The replay legs
+        # run the plane's natural dispatch-bound configuration (zscore,
+        # 8192-device rank rounds); each artifact records its own model
+        # + shape and the live leg's median is threaded into the replay
+        # artifacts below, so every file is self-describing.
+        rp = ["--replay", "--model", "zscore", "--devices", "8192",
+              "--max-inflight", "32", "--replay-events", "800000"]
+        pairs = [("live", []),
+                 ("cold", rp + ["--replay-io", "cold"]),
+                 ("warm", rp + ["--replay-io", "warm"])]
+        names = ("live saturation", "replay cold", "replay warm")
     else:  # lanes: fusion on in both, shard count is the variable
         pairs = [("lanes1", ["--egress-lanes", "1"]),
                  (f"lanes{args.lanes}", ["--egress-lanes",
@@ -451,6 +492,13 @@ def main() -> int:
                 extra = extra + [
                     "--ramp-sat-rate", str(r0["saturation_rate"]),
                     "--ramp-scale-lag", str(r0["scale_up_lag_armed"])]
+        if args.preset == "replay" and i > 0 and artifacts:
+            # stamp the live leg's measured median into each replay
+            # artifact — the committed BENCH_replay_*.json must carry
+            # its same-day denominator, not reference another file
+            lm = artifacts[0].get("value_median")
+            if lm:
+                extra = extra + ["--live-median", str(lm)]
         artifact = run_bench(extra, args.bench_args, f"{prefix}_{tag}")
         path = f"{prefix}_{tag}.json"
         with open(path, "w") as f:
@@ -459,6 +507,10 @@ def main() -> int:
         print(f"[ab_compare] wrote {path}", file=sys.stderr)
         artifacts.append(artifact)
 
+    if args.preset == "replay":
+        live, cold, warm = artifacts
+        print(replay_delta_table(live, cold, warm))
+        return 0
     b, a = artifacts  # baseline ran first (off / lanes1 / w1)
     if args.preset == "predictive":
         print(ramp_delta_table(names[1], a, names[0], b))

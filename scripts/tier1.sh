@@ -205,6 +205,78 @@ print("forecast smoke: OK (trained v%d, one forecast-attributed "
       "autoscale decision)" % report["version"])
 PY
 
+# replay smoke (docs/PERFORMANCE.md replay plane): ingest → compact →
+# replay must run the REAL spine — durable segments fold into column
+# blocks, the ReplayEngine streams them through an actual
+# SharedScoringPool megabatch slot, and the shadow-scoring gate must
+# CATCH a perturbed candidate checkpoint (and promote an equivalent
+# one) — the cold-tier → scoring-plane contract fails here in tier-1,
+# not only in the bench.
+env JAX_PLATFORMS=cpu python - <<'PY' || { echo "replay smoke: FAILED (ingest→compact→replay→gate spine broken)"; exit 1; }
+import asyncio, os, tempfile
+import jax, numpy as np
+jax.config.update("jax_platforms", "cpu")
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+from sitewhere_tpu.history import (DivergenceGateError, EventHistoryStore,
+                                   ReplayEngine, ScoreCollector)
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+from sitewhere_tpu.models.registry import build_model
+from sitewhere_tpu.persistence.durable import RT_MEASUREMENTS, SegmentLog
+from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool
+
+tmp = tempfile.mkdtemp(prefix="swx-replay-smoke-")
+log = SegmentLog(os.path.join(tmp, "events"), segment_bytes=1 << 14)
+rng = np.random.default_rng(11)
+N, D, t0 = 4000, 48, 1_700_000_000.0
+for i in range(8):
+    n = N // 8
+    dev = rng.integers(0, D, n).astype(np.uint32)
+    ts = (t0 + i * 5.0 + np.sort(rng.random(n) * 5.0)).astype(np.float64)
+    val = rng.normal(20.0, 5.0, n).astype(np.float32)
+    log.append(RT_MEASUREMENTS, MeasurementBatch(
+        BatchContext("acme"), dev, np.zeros(n, np.uint16), val,
+        ts).encode())
+log.close()
+m = MetricsRegistry()
+store = EventHistoryStore(os.path.join(tmp, "history"), source=log,
+                          window_s=10.0, metrics=m)
+rep = store.compact(through_seq=log._seq)
+assert rep["events"] == N and rep["tail_skips"] == 0, rep
+
+async def sink(s):
+    pass
+
+async def main():
+    pool = SharedScoringPool(build_model("lstm", window=16, hidden=8), m,
+                             PoolConfig(batch_buckets=(256, 2048),
+                                        batch_window_ms=1.0))
+    eng = ReplayEngine(pool, metrics=m)
+    col = ScoreCollector()
+    r = await eng.replay("acme", store, 6.0, collect=col)
+    assert r["events"] == col.total == N, r
+    slot = pool.register("acme", TelemetryStore(), 6.0, sink)
+    live = pool.stack.get_params("acme")
+    try:
+        await eng.guard_swap(slot, store,
+                             jax.tree.map(lambda a: a + 0.5, live),
+                             max_divergence=0.05)
+        raise AssertionError("perturbed candidate was NOT caught")
+    except DivergenceGateError as e:
+        assert e.report["max_abs"] > 0.05, e.report
+    v, g = await eng.guard_swap(slot, store, live, max_divergence=0.05)
+    assert g["promoted"] and g["max_abs"] == 0.0, g
+    pool.close()
+    return g
+
+g = asyncio.run(main())
+snap = m.snapshot()
+assert snap["history.compactions"] >= 1
+assert snap["history.replay_events"] >= 3 * N  # replay + two gate legs
+print("replay smoke: OK (%d events compacted+replayed, perturbed "
+      "candidate caught, equivalent candidate promoted)" % N)
+PY
+
 # fleet-observe smoke (docs/OBSERVABILITY.md fleet observability): a
 # 2-worker trace must stitch end-to-end — ONE origin-scoped trace id
 # whose spine (receive → wire hop → enrich → persist → dispatch →

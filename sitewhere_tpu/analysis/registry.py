@@ -43,6 +43,8 @@ FAULT_SITES = frozenset({
     "fleet.heartbeat",    # fleet/worker.py heartbeat publish
     "fleet.rebalance",    # fleet/controller.py placement publish
     "fence.adopt",        # services/device_management.py replay-on-adopt
+    "history.compact",    # history/store.py cold-tier compaction pass
+    "history.replay",     # history/replay.py block admission into the pool
 })
 
 # -- trace stages (kernel/tracing.py spans; TRC01 resolves literals) ---------
@@ -175,6 +177,11 @@ COUNTERS = (
     # that rode a coalesced multi-op batch frame (per-tick pipelined
     # produce/commit — docs/PERFORMANCE.md wire fast path)
     "wire.frames_coalesced",
+    # historical replay plane (sitewhere_tpu/history): compaction passes
+    # that folded ≥1 segment into cold-tier column blocks, and events
+    # streamed from those blocks through the megabatch scoring path
+    "history.compactions",
+    "history.replay_events",
 )
 
 GAUGES = (
@@ -218,6 +225,11 @@ GAUGES = (
     # most recent coalesced batch frame
     "wire.prefetch_credit",
     "wire.linger_batches",
+    # historical replay plane (sitewhere_tpu/history): events/s of the
+    # most recent replay run, and the max per-tenant score divergence
+    # from the most recent shadow-scoring comparison
+    "history.replay_rate",
+    "history.divergence_max",
 )
 
 METERS = (

@@ -12,6 +12,7 @@ rebuild ships one:
   swx top [--interval S] [--once]                  live flight-recorder view
   swx fleet status                                 fleet placement/liveness view
   swx fleet-worker --bus H:P --worker-id W         run one fleet worker
+  swx replay --data-dir D --tenant T               cold-tier replay / shadow gate
   swx lint [--format json]                         static invariant checks
 
 `run` starts every service, creates tenants from the YAML (or a default
@@ -950,6 +951,102 @@ async def cmd_demo(args) -> int:
     return 0
 
 
+async def cmd_replay(args) -> int:
+    """Offline historical replay (sitewhere_tpu/history): open one
+    tenant's durable log + cold tier under --data-dir, compact, and
+    stream the time range through a real SharedScoringPool at full
+    speed. With --candidate, run the shadow-scoring regression gate
+    instead: replay the range under fresh-init "live" params and the
+    candidate checkpoint, print the divergence report, exit 1 if the
+    gate refuses promotion. Runs against a STOPPED instance's data_dir
+    (the live instance compacts on its own cadence and serves stats at
+    GET /api/instance/replay)."""
+    from sitewhere_tpu.config import InstanceSettings
+    from sitewhere_tpu.history import (
+        DivergenceGateError,
+        EventHistoryStore,
+        ReplayEngine,
+        ScoreCollector,
+    )
+    from sitewhere_tpu.kernel.metrics import MetricsRegistry
+    from sitewhere_tpu.models import build_model
+    from sitewhere_tpu.persistence.durable import SegmentLog
+    from sitewhere_tpu.persistence.telemetry import TelemetryStore
+    from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool
+
+    settings = InstanceSettings.from_env()
+    tdir = os.path.join(args.data_dir, "tenants", args.tenant)
+    events_dir = os.path.join(tdir, "events")
+    history_dir = os.path.join(tdir, "history")
+    if not os.path.isdir(events_dir) and not os.path.isdir(history_dir):
+        print(f"replay: no durable log or cold tier under {tdir}",
+              file=sys.stderr)
+        return 2
+    metrics = MetricsRegistry()
+    source = SegmentLog(events_dir) if os.path.isdir(events_dir) else None
+    store = EventHistoryStore(
+        history_dir, source=source,
+        window_s=args.history_window or settings.history_window_s,
+        block_events=settings.history_block_events, metrics=metrics)
+    try:
+        if source is not None and not args.no_compact:
+            # the owning instance is stopped, so fold the ACTIVE
+            # segment too — "replay what just happened" must see it
+            report = store.compact(through_seq=source._seq)
+            print(f"compacted: {json.dumps(report)}", file=sys.stderr)
+        print(f"cold tier: {json.dumps(store.stats())}", file=sys.stderr)
+        model = build_model(args.model, window=args.window)
+        pool = SharedScoringPool(model, metrics, PoolConfig())
+        engine = ReplayEngine(pool, metrics=metrics)
+        try:
+            if args.candidate:
+                from sitewhere_tpu.training.checkpoint import CheckpointStore
+
+                ckpt = CheckpointStore(args.candidate)
+                cand = None
+                for owner in (args.tenant, "cli"):
+                    try:
+                        cand, meta = ckpt.load(owner, args.model,
+                                               version=args.candidate_version)
+                        break
+                    except FileNotFoundError:
+                        continue
+                if cand is None:
+                    print(f"replay: no {args.model!r} checkpoint for "
+                          f"{args.tenant!r} (or 'cli') under "
+                          f"{args.candidate}", file=sys.stderr)
+                    return 2
+
+                async def _sink(_scored) -> None:
+                    return None
+
+                slot = pool.register(args.tenant, TelemetryStore(),
+                                     args.threshold, _sink)
+                try:
+                    _version, report = await engine.guard_swap(
+                        slot, store, cand, since=args.since,
+                        until=args.until,
+                        max_divergence=args.max_divergence)
+                except DivergenceGateError as exc:
+                    print(json.dumps(exc.report, default=str))
+                    print(f"replay: {exc}", file=sys.stderr)
+                    return 1
+                print(json.dumps(report, default=str))
+                return 0
+            collector = ScoreCollector()
+            report = await engine.replay(
+                args.tenant, store, args.threshold, since=args.since,
+                until=args.until, collect=collector)
+            print(json.dumps(report))
+            return 0
+        finally:
+            pool.close()
+    finally:
+        store.close()
+        if source is not None:
+            source.close()
+
+
 async def cmd_train(args) -> int:
     """Train a model over synthetic or store-snapshot windows; with
     --distributed, join the multi-host process group (SWX_COORDINATOR /
@@ -1248,6 +1345,38 @@ def main(argv=None) -> int:
 
     sub.add_parser("bench", parents=[common], help="run the benchmark (see bench.py flags)")
 
+    p_replay = sub.add_parser(
+        "replay", parents=[common],
+        help="compact a tenant's durable log into the cold tier and "
+             "replay a time range through the scoring pool (or gate a "
+             "candidate checkpoint via --candidate)")
+    p_replay.add_argument("--data-dir", required=True,
+                          help="instance data_dir (tenants/<id>/events "
+                               "and /history live under it)")
+    p_replay.add_argument("--tenant", required=True)
+    p_replay.add_argument("--since", type=float,
+                          help="epoch seconds (window start, inclusive)")
+    p_replay.add_argument("--until", type=float,
+                          help="epoch seconds (window start, exclusive)")
+    p_replay.add_argument("--model", default="zscore")
+    p_replay.add_argument("--window", type=int, default=64)
+    p_replay.add_argument("--threshold", type=float, default=6.0)
+    p_replay.add_argument("--history-window", type=float,
+                          help="cold-tier window width in seconds "
+                               "(default: history_window_s)")
+    p_replay.add_argument("--no-compact", action="store_true",
+                          help="replay the cold tier as-is (skip the "
+                               "compaction pass)")
+    p_replay.add_argument("--candidate",
+                          help="checkpoint root of a candidate model "
+                               "(training/checkpoint.py layout) — run "
+                               "the shadow-scoring gate instead of a "
+                               "plain replay")
+    p_replay.add_argument("--candidate-version", type=int)
+    p_replay.add_argument("--max-divergence", type=float, default=0.5,
+                          help="promotion bar on max |live − candidate| "
+                               "score")
+
     p_train = sub.add_parser("train", parents=[common], help="train a model (optionally "
                                            "multi-host via --distributed)")
     p_train.add_argument("--model", default="lstm")
@@ -1281,7 +1410,7 @@ def main(argv=None) -> int:
 
         return subprocess.call([sys.executable, "bench.py", *extra,
                                 *(["--force-cpu"] if args.cpu else [])])
-    if args.cmd in ("run", "demo", "train", "fleet-worker"):
+    if args.cmd in ("run", "demo", "train", "fleet-worker", "replay"):
         # model-plane commands: resolve the backend first so a dead
         # tunnel degrades to CPU instead of hanging the command
         plat = _select_backend(args.cpu)
@@ -1292,7 +1421,8 @@ def main(argv=None) -> int:
     coro = {"run": cmd_run, "simulate": cmd_simulate, "demo": cmd_demo,
             "train": cmd_train, "serve-bus": cmd_serve_bus,
             "dlq": cmd_dlq, "quota": cmd_quota, "top": cmd_top,
-            "fleet": cmd_fleet, "fleet-worker": cmd_fleet_worker}[args.cmd]
+            "fleet": cmd_fleet, "fleet-worker": cmd_fleet_worker,
+            "replay": cmd_replay}[args.cmd]
     return asyncio.run(coro(args))
 
 

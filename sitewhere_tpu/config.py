@@ -134,6 +134,19 @@ class InstanceSettings:
     durable_fsync_interval_s: float = 0.2
     durable_segment_bytes: int = 4 << 20
     durable_max_segments: int = 64
+    # historical replay plane (sitewhere_tpu/history, docs/PERFORMANCE.md
+    # replay): a background compactor folds each tenant's sealed durable
+    # segments into per-(tenant, window) columnar cold-tier blocks the
+    # ReplayEngine streams back through the megabatch scoring path at
+    # full speed. `history_window_s` is the cold-tier time-window width
+    # (coarser than observe_history_window_s — these are event columns,
+    # not telemetry rollups); `history_block_events` caps events per
+    # block flush; `history_compact_interval_s` > 0 runs the compactor
+    # on that cadence inside the event-management engine (0 = on-demand:
+    # CLI/REST/bench drive compaction explicitly). Needs a data_dir.
+    history_window_s: float = 60.0
+    history_block_events: int = 65536
+    history_compact_interval_s: float = 0.0
     # flow control (kernel/flow.py): per-tenant ingress quota defaults —
     # a tenant's `flow:` config section overrides these. rate 0 =
     # unlimited (admission is then shed-mode-gated only). burst 0 →
@@ -209,6 +222,13 @@ class InstanceSettings:
     fleet_forecast_min_windows: int = 8     # history-thin demotion bar
     fleet_forecast_max_stale_s: float = 30.0
     fleet_forecast_error_gate: float = 3.0  # relative horizon-error EMA bar
+    # controller-loop retrain cadence (PR-15's open thread): > 0 retrains
+    # the tenant-0 forecaster from the history tier every
+    # `fleet_forecast_retrain_s` seconds inside the planner tick
+    # (executor-offloaded — the controller loop keeps ticking), audit-
+    # logged into the autoscaler decision trail. 0 = on-demand only
+    # (bench setup / runbook `train_from_history`), the PR-15 behavior.
+    fleet_forecast_retrain_s: float = 0.0
     # wire data-plane fast path (kernel/wire.py, docs/PERFORMANCE.md):
     # `wire_prefetch` streams record batches broker→consumer under a
     # credit window of `wire_prefetch_credit` records (poll() drains a

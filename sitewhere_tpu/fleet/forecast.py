@@ -43,6 +43,7 @@ Three pieces, one design rule — the platform is its own tenant:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import math
 import time
@@ -265,6 +266,16 @@ class PredictivePlanner:
         self._demoted = False
         self._gate_reason: Optional[str] = "serving path not started"
         self._last_tick = -1e9
+        # controller-loop retrain cadence (closes PR-15's open thread):
+        # > 0 refreshes tenant-0 from the history tier on schedule. The
+        # first window is measured from planner construction, not from
+        # an immediate train — boot-time history is exactly what
+        # train_from_history would reject as thin
+        self.retrain_s = float(getattr(settings,
+                                       "fleet_forecast_retrain_s", 0.0))
+        self._last_retrain = time.monotonic()
+        self._retraining = False
+        self.scheduled_retrains = 0
 
     # -- tenant-0 serving ----------------------------------------------------
 
@@ -386,6 +397,47 @@ class PredictivePlanner:
         for tid in sorted(self.controller.tenants):
             self._admit_closed_windows(tid, open_start)
         self._resolve_checks(time.time())
+        await self._maybe_retrain(now)
+
+    async def _maybe_retrain(self, now: float) -> None:
+        """Scheduled retrain (`fleet_forecast_retrain_s` > 0): refresh
+        the tenant-0 forecaster from the history tier on cadence
+        instead of on demand. The train runs in an executor thread —
+        Trainer.train is seconds of blocking JAX work and the
+        controller loop must keep ticking through it — and the
+        `_retraining` latch keeps the cadence to one train in flight
+        (a slow train never stacks a second). Each completed retrain
+        is transition-counted (`scheduled_retrains`, one per event,
+        not per tick) and audit-logged into the autoscaler decision
+        trail beside scale actions."""
+        if self.retrain_s <= 0 or self._retraining:
+            return
+        if now - self._last_retrain < self.retrain_s:
+            return
+        self._retraining = True
+        try:
+            report = await asyncio.get_running_loop().run_in_executor(
+                None, self.train_from_history)
+        except Exception:  # noqa: BLE001 - cadence must survive one bad pass
+            logger.exception("fleet forecast: scheduled retrain failed; "
+                             "next window retries")
+            report = None
+        finally:
+            self._last_retrain = time.monotonic()
+            self._retraining = False
+        if report is None:
+            return  # history too thin (already logged) or train failed
+        self.scheduled_retrains += 1
+        self.controller.decisions.append({
+            "t": time.time(), "action": "retrain", "actuated": True,
+            "reason": f"scheduled (every {self.retrain_s:g}s)",
+            "version": report.get("version"),
+            "windows": report.get("windows"),
+            "final_loss": report.get("final_loss")})
+        del self.controller.decisions[:-32]
+        logger.info("fleet forecast: scheduled retrain #%d -> v%s "
+                    "(%s windows)", self.scheduled_retrains,
+                    report.get("version"), report.get("windows"))
 
     def _admit_closed_windows(self, tid: str, open_start: float) -> None:
         """Admit one point per newly CLOSED aggregation window through
@@ -614,6 +666,9 @@ class PredictivePlanner:
             "decisions": int(self.decisions_c.value),
             "demotions": int(self.demotions_c.value),
             "trainings": int(self.trainings_c.value),
+            "retrain_s": self.retrain_s,
+            "scheduled_retrains": self.scheduled_retrains,
+            "last_retrain_age_s": round(now - self._last_retrain, 1),
             "forecasts": {
                 tid: {"load": round(f["load"], 1),
                       "age_s": round(now - f["made_monotonic"], 1),

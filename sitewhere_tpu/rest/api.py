@@ -355,6 +355,7 @@ class RestServer(LifecycleComponent):
         # per-tenant signal series readback — ?tenant=&signal=&since=
         # &until=&limit= (no params lists the available series)
         r("GET", r"/api/instance/history", self.get_history)
+        r("GET", r"/api/instance/replay", self.get_replay)
         # pipeline tracing [SURVEY.md §5.1]; all three accept ?tenant=
         # and the listing endpoints paginate with ?limit=&offset=
         r("GET", r"/api/instance/traces", self.get_trace_summary)
@@ -606,6 +607,37 @@ class RestServer(LifecycleComponent):
             limit=req.int_qp("limit", -1))
         return {"tenant": tenant, "signal": signal,
                 "window_s": history.window_s, "rows": rows}
+
+    async def get_replay(self, req: Request):
+        """Historical replay plane state (sitewhere_tpu/history): each
+        tenant's cold-tier store stats (blocks, windows, events,
+        compaction high-water mark, tail skips) plus the last replay
+        rate / shadow divergence gauges. `?tenant=` filters to one
+        tenant. Read-only — compaction and replay runs are driven by
+        `swx replay` (offline) or the maintenance cadence."""
+        svc = self.runtime.services.get("event-management")
+        if svc is None:
+            raise HttpError(404, "no event-management in this process")
+        only = req.qp("tenant")
+        tenants = {}
+        for tid, engine in sorted(svc.engines.items()):
+            if only is not None and tid != only:
+                continue
+            store = getattr(engine, "history_store", None)
+            if store is not None:
+                tenants[tid] = store.stats()
+        if not tenants:
+            raise HttpError(404, "no cold tier in this process "
+                            "(needs data_dir)" if only is None else
+                            f"no cold tier for tenant {only!r}")
+        metrics = self.runtime.metrics
+        return {"tenants": tenants,
+                "replay_rate": metrics.gauge("history.replay_rate").value,
+                "divergence_max":
+                    metrics.gauge("history.divergence_max").value,
+                "replay_events":
+                    metrics.counter("history.replay_events").value,
+                "compactions": metrics.counter("history.compactions").value}
 
     async def get_trace_summary(self, req: Request):
         return self.runtime.tracer.stage_summary(tenant=req.qp("tenant"))

@@ -228,6 +228,10 @@ class TenantSlot:
     def admit(self, batch: MeasurementBatch) -> None:
         self.pool.admit(self.tenant_id, batch)
 
+    def admit_columns(self, device_index: np.ndarray, value: np.ndarray,
+                      ts: np.ndarray, ctx: BatchContext) -> None:
+        self.pool.admit_columns(self.tenant_id, device_index, value, ts, ctx)
+
     def swap_params(self, params: dict) -> int:
         version = self.pool.stack.set_params(self.tenant_id, params)
         if self.pool.streaming:
@@ -582,6 +586,37 @@ class SharedScoringPool:
             self._deadline = time.monotonic() + self._window_s
         self._wake.set()
 
+    def admit_columns(self, tenant_id: str, device_index: np.ndarray,
+                      value: np.ndarray, ts: np.ndarray,
+                      ctx: BatchContext) -> None:
+        """Column-block admission for the historical replay plane
+        (sitewhere_tpu/history): the caller hands scoring columns
+        straight out of a decoded cold-tier block — already
+        mtype-filtered, so no MeasurementBatch wrapper, no mask pass,
+        no admit-stage latency sample (a replayed event's ingest time
+        is its original one; measuring "admission delay" against it
+        would record hours, not microseconds) and no window-tuner vote
+        (replay slots register internal, like tenant-0). Internal-only
+        contract: live ingress keeps going through admit()."""
+        entry = self.tenants[tenant_id]
+        if self.faults is not None:
+            # same chaos seams as admit(): a raised fault surfaces in
+            # the replay driver before the block is taken
+            self.faults.check("scoring.megabatch")
+            if self.mesh is not None:
+                self.faults.check("scoring.mesh")
+        n = device_index.shape[0]
+        if n == 0:
+            return
+        now = time.monotonic()
+        entry.pending.append((device_index, value, ts,
+                              np.full(n, ctx.ingest_monotonic), ctx, now))
+        entry.pending_n += n
+        self._pending_max = max(self._pending_max, int(device_index.max()))
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self._window_s
+        self._wake.set()
+
     # -- flushing -----------------------------------------------------------
 
     @property
@@ -798,13 +833,24 @@ class SharedScoringPool:
                     taken.append(p)
                     traces.append((p[4].trace_id, n, p[5]))
                     budget -= n
-                else:
+                elif not taken:
                     head = tuple(c[:budget] for c in p[:4]) + (p[4], p[5])
                     e.pending[0] = tuple(c[budget:] for c in p[:4]) \
                         + (p[4], p[5])
                     taken.append(head)
                     traces.append((p[4].trace_id, budget, p[5]))
                     budget = 0
+                else:
+                    # leftover budget smaller than the next whole batch:
+                    # end the take at the batch boundary instead of
+                    # shearing it. A sheared head used to drag the
+                    # boundary batch's events into this take — for the
+                    # replay plane's rank-round chunks that turns two
+                    # duplicate-free takes into two dup-bearing ones,
+                    # each paying the occurrence split (argsort+unique)
+                    # the rounds were packed to avoid. The remainder
+                    # keeps its own ctx and leads the next round.
+                    break
                 self.stage_batch.observe(now - p[5])
             e.pending_n = sum(p[0].shape[0] for p in e.pending)
             if e.pending_n:
@@ -837,18 +883,24 @@ class SharedScoringPool:
         for tid, (dev, val, ts, ing, traces, ctx) in takes.items():
             slot = self.stack.slots[tid]
             n = dev.shape[0]
-            counts = np.unique(dev, return_counts=True)[1] if n else np.array([1])
             ev_rounds = []
-            if counts.max() == 1:
+            # O(n) duplicate-free fast path before the O(n log n)
+            # unique/argsort split: a strictly-ascending take (the
+            # replay engine's rank-round chunks; near-sequential
+            # simulator ids) needs no occurrence split at all
+            if n < 2 or bool((dev[1:] > dev[:-1]).all()):
                 parts = [(dev, val, None)]
             else:
                 order = np.argsort(dev, kind="stable")
                 sd, sv = dev[order], val[order]
                 _, start, cnts = np.unique(sd, return_index=True,
                                            return_counts=True)
-                cum = np.arange(n) - np.repeat(start, cnts)
-                parts = [(sd[cum == r], sv[cum == r], order[cum == r])
-                         for r in range(int(cum.max()) + 1)]
+                if int(cnts.max()) == 1:
+                    parts = [(dev, val, None)]
+                else:
+                    cum = np.arange(n) - np.repeat(start, cnts)
+                    parts = [(sd[cum == r], sv[cum == r], order[cum == r])
+                             for r in range(int(cum.max()) + 1)]
             for r, (rdev, rval, rpos) in enumerate(parts):
                 while len(round_parts) <= r:
                     round_parts.append([])

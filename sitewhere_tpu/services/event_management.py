@@ -43,6 +43,9 @@ class EventManagementEngine(TenantEngine):
     def __init__(self, service: "EventManagementService", tenant: TenantConfig):
         super().__init__(service, tenant)
         self.spi: InMemoryDeviceEventManagement = None  # type: ignore[assignment]
+        # cold tier over the durable log (sitewhere_tpu/history); None
+        # unless this tenant persists to disk
+        self.history_store = None
         # `egress: {lanes: N}` (kernel/egresslane.py) shards the persist
         # consumer: N loops in the one `{tenant}.event-management`
         # group split the inbound topic's partitions (per-device order
@@ -86,14 +89,41 @@ class EventManagementEngine(TenantEngine):
             logger.info("event-management[%s]: replayed durable log "
                         "(%d events now in store)", self.tenant_id,
                         self.spi.telemetry.total_events)
+        if durable is not None:
+            # historical replay plane: the cold tier lives beside the
+            # durable log it compacts. Maintenance runs on its own
+            # thread (disk+numpy — same off-loop split as the durable
+            # writer); interval 0 leaves compaction on-demand
+            # (`swx replay --compact`, tests, REST).
+            from sitewhere_tpu.history import EventHistoryStore
+
+            self.history_store = EventHistoryStore(
+                os.path.join(data_dir, "tenants", self.tenant_id,
+                             "history"),
+                source=durable.log,
+                window_s=cfg.get("history_window_s",
+                                 settings.history_window_s),
+                block_events=cfg.get("history_block_events",
+                                     settings.history_block_events),
+                metrics=self.runtime.metrics,
+                faults=self.runtime.faults)
+            interval = cfg.get("history_compact_interval_s",
+                               settings.history_compact_interval_s)
+            if interval and interval > 0:
+                self.history_store.start_maintenance(float(interval))
 
     async def _do_stop(self, monitor) -> None:
         await super()._do_stop(monitor)
+        import asyncio
+
+        if self.history_store is not None:
+            # stop the compaction thread before the durable log closes
+            # under it
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.history_store.close)
         if self.spi is not None and self.spi.durable is not None:
             # drain + fsync the spill queue off-loop so a clean shutdown
             # loses nothing (hard kills are bounded by fsync_interval_s)
-            import asyncio
-
             await asyncio.get_event_loop().run_in_executor(
                 None, self.spi.durable.close)
 

@@ -1,0 +1,360 @@
+"""Cold-tier compaction + replay-plane tests (sitewhere_tpu/history).
+
+Covers the PR-20 correctness contract: flush-split windows merge at
+read; restart mid-compaction resumes idempotently (crash-before-
+manifest leaves orphan bytes, never duplicate reads); a CRC/torn tail
+is skipped LOUDLY and counted; double replay is byte-identical; replay
+scores the exact records live scored (same records, same model version
+-> identical scores); the shadow-scoring gate trips on a diverged
+candidate and promotes an equivalent one; the version fence aborts a
+replay when a hot-swap lands mid-range.
+"""
+
+import asyncio
+import glob
+import logging
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.domain.batch import BatchContext, MeasurementBatch
+from sitewhere_tpu.history import (DivergenceGateError, EventHistoryStore,
+                                   ReplayEngine, ReplayFenceError,
+                                   ScoreCollector)
+from sitewhere_tpu.kernel.metrics import MetricsRegistry
+from sitewhere_tpu.models.registry import build_model
+from sitewhere_tpu.persistence.durable import RT_MEASUREMENTS, SegmentLog
+from sitewhere_tpu.persistence.telemetry import TelemetryStore
+from sitewhere_tpu.scoring.pool import PoolConfig, SharedScoringPool
+
+T0 = 1_700_000_000.0
+DEVICES = 32
+
+
+def build_corpus(root, n_batches=8, per_batch=500, devices=DEVICES,
+                 segment_bytes=1 << 14, seed=7):
+    """Append `n_batches` measurement batches to a SegmentLog with a
+    strictly increasing (hence globally unique) ts column — order and
+    identity checks below lean on that. Small segments force several
+    sealed files per corpus."""
+    rng = np.random.default_rng(seed)
+    log = SegmentLog(root, segment_bytes=segment_bytes)
+    batches = []
+    for i in range(n_batches):
+        n = per_batch
+        dev = rng.integers(0, devices, n).astype(np.uint32)
+        base = T0 + i * per_batch * 0.01
+        ts = (base + np.arange(n) * 0.01).astype(np.float64)
+        val = rng.normal(20.0, 5.0, n).astype(np.float32)
+        b = MeasurementBatch(BatchContext("acme"), dev,
+                             np.zeros(n, np.uint16), val, ts)
+        log.append(RT_MEASUREMENTS, b.encode())
+        batches.append(b)
+    log.close()
+    return log, batches
+
+
+def read_all(store):
+    """Concatenate every window read_range yields, in yield order."""
+    dev, val, ts = [], [], []
+    for _, cols in store.read_range():
+        dev.append(np.asarray(cols["device_index"]))
+        val.append(np.asarray(cols["value"]))
+        ts.append(np.asarray(cols["ts"]))
+    if not dev:
+        return (np.empty(0, np.uint32), np.empty(0, np.float32),
+                np.empty(0, np.float64))
+    return np.concatenate(dev), np.concatenate(val), np.concatenate(ts)
+
+
+class TestCompaction:
+    def test_flush_split_windows_merge_at_read(self, tmp_path):
+        # one giant window + tiny block_events => many blocks, one
+        # window; read_range must hand back a single merged column set
+        # in log order
+        log, batches = build_corpus(str(tmp_path / "events"))
+        store = EventHistoryStore(str(tmp_path / "hist"), source=log,
+                                  window_s=1e9, block_events=100)
+        rep = store.compact(through_seq=log._seq)
+        n = sum(len(b) for b in batches)
+        assert rep["events"] == n
+        st = store.stats()
+        assert st["windows"] == 1 and st["blocks"] > 1
+        windows = list(store.read_range())
+        assert len(windows) == 1
+        dev, val, ts = read_all(store)
+        want_ts = np.concatenate([b.ts for b in batches])
+        want_dev = np.concatenate([b.device_index for b in batches])
+        assert ts.tobytes() == want_ts.tobytes()      # exact log order
+        assert dev.tobytes() == want_dev.tobytes()
+
+    def test_restart_mid_compaction_resumes_idempotently(self, tmp_path):
+        log, batches = build_corpus(str(tmp_path / "events"))
+        n = sum(len(b) for b in batches)
+        seqs = [seq for seq, _ in log._segments()]
+        assert len(seqs) > 2, "corpus must span several sealed segments"
+        mid = seqs[len(seqs) // 2]
+
+        store = EventHistoryStore(str(tmp_path / "hist"), source=log,
+                                  window_s=30.0)
+        rep1 = store.compact(through_seq=mid)
+        assert 0 < rep1["events"] < n
+        assert store.compacted_through_seq == mid
+
+        # "restart": a fresh instance over the same directory resumes
+        # from the manifest high-water mark, folding only the rest
+        store2 = EventHistoryStore(str(tmp_path / "hist"), source=log,
+                                   window_s=30.0)
+        assert store2.compacted_through_seq == mid
+        rep2 = store2.compact(through_seq=log._seq)
+        assert rep1["events"] + rep2["events"] == n
+        assert store2.stats()["events"] == n
+
+        # idempotent: nothing left to fold, and a re-run adds no events
+        rep3 = store2.compact(through_seq=log._seq)
+        assert rep3 == {"segments": 0, "events": 0, "blocks": 0}
+        assert store2.stats()["events"] == n
+
+        # a window flush-split ACROSS the two passes still merges at
+        # read, preserving log order end to end
+        _, _, ts = read_all(store2)
+        assert ts.shape[0] == n and bool((np.diff(ts) > 0).all())
+
+    def test_crash_before_manifest_rewrite_never_duplicates(self, tmp_path):
+        # crash model (store.py module docstring): a pass that died
+        # after appending blocks but BEFORE the manifest rewrite leaves
+        # unreferenced bytes in the block file. Simulate by restoring
+        # the pre-pass manifest, then re-run: events read once, never
+        # twice.
+        log, batches = build_corpus(str(tmp_path / "events"))
+        n = sum(len(b) for b in batches)
+        seqs = [seq for seq, _ in log._segments()]
+        mid = seqs[len(seqs) // 2]
+        hist = tmp_path / "hist"
+        store = EventHistoryStore(str(hist), source=log, window_s=30.0)
+        store.compact(through_seq=mid)
+        manifest = hist / "manifest.json"
+        saved = manifest.read_bytes()
+
+        store.compact(through_seq=log._seq)        # the pass that "crashes"
+        manifest.write_bytes(saved)                # ...before its rewrite
+
+        store2 = EventHistoryStore(str(hist), source=log, window_s=30.0)
+        assert store2.compacted_through_seq == mid
+        store2.compact(through_seq=log._seq)       # resume re-folds the rest
+        assert store2.stats()["events"] == n
+        _, _, ts = read_all(store2)
+        assert ts.shape[0] == n and np.unique(ts).shape[0] == n
+
+    def test_torn_tail_skipped_loudly_and_counted(self, tmp_path, caplog):
+        log, batches = build_corpus(str(tmp_path / "events"))
+        n = sum(len(b) for b in batches)
+        segs = [p for p in sorted(glob.glob(str(tmp_path / "events" / "*")))
+                if os.path.getsize(p) > 0]   # skip the empty active seg
+        last = segs[-1]
+        size = os.path.getsize(last)
+        with open(last, "r+b") as f:       # tear the final record
+            f.truncate(size - 7)
+        store = EventHistoryStore(str(tmp_path / "hist"), source=log,
+                                  window_s=30.0)
+        with caplog.at_level(logging.WARNING,
+                             logger="sitewhere_tpu.history.store"):
+            rep = store.compact(through_seq=log._seq)
+        assert rep["tail_skips"] >= 1
+        assert store.stats()["tail_skips"] >= 1
+        assert 0 < rep["events"] < n       # intact prefix kept, tail gone
+        assert any("tail skipped" in r.message for r in caplog.records)
+        # the count survives restart via the manifest
+        store2 = EventHistoryStore(str(tmp_path / "hist"), source=log)
+        assert store2.stats()["tail_skips"] >= 1
+
+    def test_crc_corruption_skips_tail_loudly(self, tmp_path, caplog):
+        log, batches = build_corpus(str(tmp_path / "events"))
+        n = sum(len(b) for b in batches)
+        segs = [p for p in sorted(glob.glob(str(tmp_path / "events" / "*")))
+                if os.path.getsize(p) > 0]
+        with open(segs[-1], "r+b") as f:
+            # flip a byte INSIDE the first record's payload (past the
+            # 9-byte len|crc|rtype header) => CRC mismatch, not torn-len
+            f.seek(9 + 100)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        store = EventHistoryStore(str(tmp_path / "hist"), source=log,
+                                  window_s=30.0)
+        with caplog.at_level(logging.WARNING,
+                             logger="sitewhere_tpu.history.store"):
+            rep = store.compact(through_seq=log._seq)
+        assert rep["tail_skips"] >= 1
+        assert rep["events"] < n
+        assert any("CRC mismatch" in r.message for r in caplog.records)
+
+
+def make_pool(metrics, model_name="zscore", **model_kw):
+    model = build_model(model_name, window=16, **model_kw)
+    return SharedScoringPool(model, metrics,
+                             PoolConfig(batch_buckets=(256, 2048),
+                                        batch_window_ms=1.0))
+
+
+class TestReplay:
+    def _corpus_store(self, tmp_path, metrics=None):
+        log, batches = build_corpus(str(tmp_path / "events"))
+        store = EventHistoryStore(str(tmp_path / "hist"), source=log,
+                                  window_s=30.0, metrics=metrics)
+        store.compact(through_seq=log._seq)
+        return log, batches, store
+
+    def test_double_replay_byte_identical(self, run, tmp_path):
+        metrics = MetricsRegistry()
+        log, batches, store = self._corpus_store(tmp_path, metrics)
+        n = sum(len(b) for b in batches)
+
+        async def go():
+            pool = make_pool(metrics)
+            try:
+                eng = ReplayEngine(pool, metrics=metrics)
+                c1, c2 = ScoreCollector(), ScoreCollector()
+                r1 = await eng.replay("acme", store, 6.0, collect=c1)
+                r2 = await eng.replay("acme", store, 6.0, collect=c2)
+                assert r1["events"] == r2["events"] == n
+                assert r1["scored"] == r2["scored"] == n
+                t1, t2 = c1.table(), c2.table()
+                for a, b in zip(t1, t2):
+                    assert a.tobytes() == b.tobytes()
+            finally:
+                pool.close()
+
+        run(go())
+
+    def test_replay_matches_live_scoring(self, run, tmp_path):
+        # the acceptance pin: the same records through the LIVE admit
+        # path and through compaction+replay produce identical scored
+        # output — same model version, byte-identical score table
+        metrics = MetricsRegistry()
+        log, batches, store = self._corpus_store(tmp_path)
+        n = sum(len(b) for b in batches)
+
+        async def go():
+            pool = make_pool(metrics)
+            try:
+                live = ScoreCollector()
+                slot = pool.register("acme", TelemetryStore(), 6.0, live)
+                for b in batches:
+                    slot.admit(b)
+                    while not slot.idle:
+                        slot.flush_nowait()
+                        await asyncio.sleep(0.002)
+                pool.unregister("acme")
+
+                eng = ReplayEngine(pool, metrics=metrics)
+                rep = ScoreCollector()
+                r = await eng.replay("acme", store, 6.0, collect=rep)
+                assert r["events"] == n
+                lt, rt = live.table(), rep.table()
+                assert live.versions == rep.versions
+                for a, b in zip(lt, rt):
+                    assert a.tobytes() == b.tobytes()
+                assert lt[0].shape[0] == n
+            finally:
+                pool.close()
+
+        run(go())
+
+    def test_fence_aborts_on_midreplay_swap(self, run, tmp_path):
+        metrics = MetricsRegistry()
+        log, batches, store = self._corpus_store(tmp_path)
+        assert len(store.windows()) >= 2
+
+        class SwapAfterFirstWindow:
+            """read_range shim that lands a hot-swap between windows —
+            deterministically mid-replay."""
+
+            def __init__(self, inner, slot):
+                self.inner, self.slot = inner, slot
+
+            def read_range(self, since=None, until=None):
+                for i, item in enumerate(self.inner.read_range(since,
+                                                               until)):
+                    yield item
+                    if i == 0:
+                        self.slot.swap_params(
+                            self.slot.pool.stack.get_params("acme"))
+
+        async def sink(scored):
+            pass
+
+        async def go():
+            pool = make_pool(metrics)
+            try:
+                slot = pool.register("acme", TelemetryStore(), 6.0, sink)
+                eng = ReplayEngine(pool, metrics=metrics)
+                shim = SwapAfterFirstWindow(store, slot)
+                with pytest.raises(ReplayFenceError):
+                    await eng.replay("acme", shim, 6.0, fence=slot)
+                # the transient replay slot must not leak on abort
+                assert all(not t.startswith("tenant-0.replay:")
+                           for t in pool.tenants)
+            finally:
+                pool.close()
+
+        run(go())
+
+    def test_divergence_gate_trips_and_promotes(self, run, tmp_path):
+        import jax
+
+        metrics = MetricsRegistry()
+        log, batches, store = self._corpus_store(tmp_path)
+
+        async def sink(scored):
+            pass
+
+        async def go():
+            # zscore is stateless-params — the gate needs a parametric
+            # model to have anything to diverge
+            pool = make_pool(metrics, "lstm", hidden=8)
+            try:
+                eng = ReplayEngine(pool, metrics=metrics)
+                slot = pool.register("acme", TelemetryStore(), 6.0, sink)
+                live = pool.stack.get_params("acme")
+                bad = jax.tree.map(lambda a: a + 0.5, live)
+                v0 = slot.version
+                with pytest.raises(DivergenceGateError) as ei:
+                    await eng.guard_swap(slot, store, bad,
+                                         max_divergence=0.05)
+                assert ei.value.report["max_abs"] > 0.05
+                assert ei.value.report["promoted"] is False
+                assert slot.version == v0          # refused => no swap
+                snap = metrics.snapshot()
+                assert snap["history.divergence_max"] > 0.05
+
+                # an equivalent candidate sails through and promotes
+                v, rep = await eng.guard_swap(slot, store, live,
+                                              max_divergence=0.05)
+                assert rep["promoted"] and rep["max_abs"] == 0.0
+                assert v == slot.version > v0
+            finally:
+                pool.close()
+
+        run(go())
+
+    def test_metrics_and_counters(self, run, tmp_path):
+        metrics = MetricsRegistry()
+        log, batches, store = self._corpus_store(tmp_path, metrics)
+        n = sum(len(b) for b in batches)
+
+        async def go():
+            pool = make_pool(metrics)
+            try:
+                eng = ReplayEngine(pool, metrics=metrics)
+                await eng.replay("acme", store, 6.0)
+            finally:
+                pool.close()
+
+        run(go())
+        snap = metrics.snapshot()
+        assert snap["history.compactions"] >= 1
+        assert snap["history.replay_events"] == n
+        assert snap["history.replay_rate"] > 0
